@@ -1,0 +1,110 @@
+"""Single-chip training benchmark. Prints ONE JSON line for the driver.
+
+Measures the full compiled training step (fwd + bwd + optimizer, bf16
+compute / fp32 params, remat) on the GPT-2-small 124M `openwebtext` shape and
+reports MFU. Baseline for `vs_baseline` is the reference's published 47.8%
+MFU on its headline 1.5B run (reference README; BASELINE.md) — MFU is the
+hardware-normalized metric that is comparable across chip counts.
+
+Usage: python bench.py [--steps N] [--batch B] [--attn naive|flash|blockwise]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_MFU = 0.478  # reference 1.5B on v3-128 (BASELINE.md)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--attn", type=str, default=None, choices=[None, "naive", "flash", "blockwise"])
+    args = parser.parse_args()
+
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.configs.openwebtext import config as base_config
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.parallel.data import make_global_batch
+    from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
+    from midgpt_tpu.training.metrics import device_peak_flops, flops_per_token
+    from midgpt_tpu.training.train import init_state, make_train_step
+
+    n_dev = jax.device_count()
+    model_cfg = base_config.model_config
+    attn = args.attn or "naive"  # TODO: default to 'flash' once the Pallas kernel lands
+    import dataclasses
+
+    model_cfg = dataclasses.replace(model_cfg, attn_impl=attn)
+    config = base_config.replace(
+        batch_size=args.batch * n_dev,
+        g_accum_iters=1,
+        shard_model=n_dev > 1,
+        mesh=MeshConfig(data=1, fsdp=n_dev, sp=1),
+        model_config=model_cfg,
+        debug=True,
+    )
+
+    mesh = make_mesh(config.mesh)
+    params, opt_state, specs, optimizer = init_state(config, mesh)
+    step, _ = make_train_step(config, optimizer, mesh, specs)
+
+    T = model_cfg.block_size
+    B = config.batch_size
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, model_cfg.vocab_size, (1, B, T), dtype=np.int32)
+    y = np.roll(x, -1, axis=-1)
+    xg = make_global_batch(x, mesh, batch_spec())
+    yg = make_global_batch(y, mesh, batch_spec())
+
+    key = jax.random.PRNGKey(0)
+    loss = None
+    for i in range(args.warmup):
+        key, k = jax.random.split(key)
+        params, opt_state, loss = step(params, opt_state, xg, yg, k)
+    float(loss)  # device_get: hard host sync (block_until_ready is not
+    # sufficient under the axon remote-TPU tunnel)
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        key, k = jax.random.split(key)
+        params, opt_state, loss = step(params, opt_state, xg, yg, k)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = args.steps * B * T / dt
+    fpt = flops_per_token(model_cfg)
+    peak = device_peak_flops()
+    achieved = tokens_per_sec * fpt / n_dev
+    mfu = achieved / peak if peak else None
+
+    result = {
+        "metric": f"train_mfu_124m_{attn}_{jax.devices()[0].platform}",
+        "value": round(mfu * 100, 2) if mfu is not None else round(tokens_per_sec, 0),
+        "unit": "% MFU" if mfu is not None else "tokens/sec",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3) if mfu is not None else None,
+        "detail": {
+            "tokens_per_sec": round(tokens_per_sec, 0),
+            "step_ms": round(1000 * dt / args.steps, 2),
+            "batch": B,
+            "seq_len": T,
+            "n_devices": n_dev,
+            "device": getattr(jax.devices()[0], "device_kind", "?"),
+            "final_loss": final_loss,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

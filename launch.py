@@ -1,0 +1,113 @@
+"""Training launcher CLI (the reference's primary entry point, launch.py:15-72).
+
+    python launch.py --config=shakespeare_char [--rundir=...] [--debug] \
+        [--multihost] [--set key=value ...]
+
+Behavior parity: dynamic config import by name, timestamped rundir default,
+config.json persisted to the rundir (local or gs://) for sample-time
+reconstruction, wandb-id persistence for resume (when wandb is installed),
+cross-host barrier after proc-0 setup, then train(). `--set` dotted overrides
+(e.g. --set max_steps=100 --set model_config.n_layer=4) are an addition the
+reference lacks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from datetime import datetime
+
+
+def apply_override(config, dotted_key: str, raw_value: str):
+    """Set a (possibly nested) config field from a CLI string."""
+    parts = dotted_key.split(".")
+    target = config
+    for p in parts[:-1]:
+        target = getattr(target, p)
+    field = parts[-1]
+    current = getattr(target, field)
+    ftype = type(current) if current is not None else str
+    value = (raw_value.lower() in ("1", "true", "yes")) if ftype is bool else ftype(raw_value)
+
+    def rebuild(obj, path, v):
+        if not path[:-1]:
+            return dataclasses.replace(obj, **{path[-1]: v})
+        child = getattr(obj, path[0])
+        return dataclasses.replace(obj, **{path[0]: rebuild(child, path[1:], v)})
+
+    return rebuild(config, parts, value)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, required=True)
+    parser.add_argument("--rundir", type=str)
+    parser.add_argument("--debug", action="store_true")
+    parser.add_argument("--multihost", action="store_true")
+    parser.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="dotted config override, e.g. --set model_config.n_layer=4",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    # Platform override for dev boxes/CI (the axon TPU plugin ignores the
+    # JAX_PLATFORMS env var, so route through the config API).
+    if os.environ.get("MIDGPT_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["MIDGPT_PLATFORM"])
+        if os.environ.get("MIDGPT_CPU_DEVICES"):
+            jax.config.update("jax_num_cpu_devices", int(os.environ["MIDGPT_CPU_DEVICES"]))
+
+    if args.multihost:
+        jax.distributed.initialize()
+
+    from midgpt_tpu.config import load_config, to_json
+    from midgpt_tpu.training.train import train
+
+    config = load_config(args.config)
+    for kv in args.set:
+        key, _, value = kv.partition("=")
+        config = apply_override(config, key, value)
+
+    if args.rundir is not None:
+        config = config.replace(rundir=args.rundir)
+    elif not args.debug:
+        assert not args.multihost, "multihost runs must prespecify --rundir"
+        config = config.replace(
+            rundir=os.path.abspath(
+                os.path.join("outputs", datetime.now().strftime("%Y-%m-%d-%H-%M-%S"))
+            )
+        )
+    if args.debug:
+        config = config.replace(debug=True)
+
+    if jax.process_index() == 0 and not config.debug and config.rundir:
+        if config.rundir.startswith("gs://"):
+            import gcsfs
+
+            fs = gcsfs.GCSFileSystem()
+            fs.makedirs(config.rundir, exist_ok=True)
+            with fs.open(os.path.join(config.rundir, "config.json"), "w") as f:
+                f.write(to_json(config))
+        else:
+            os.makedirs(config.rundir, exist_ok=True)
+            with open(os.path.join(config.rundir, "config.json"), "w") as f:
+                f.write(to_json(config))
+        print(f"Writing to {config.rundir}")
+
+    if args.multihost:
+        from jax.experimental.multihost_utils import sync_global_devices
+
+        sync_global_devices("end_setup")
+
+    print(config)
+    train(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Experiment configuration (mirrors reference train.py:26-44 + launch.py persistence).
+
+Configs are plain frozen dataclasses; named presets live in
+`midgpt_tpu/configs/*.py` as modules exposing a module-level `config`, loaded
+by name (same UX as reference launch.py:25-27). `to_json`/`from_json` give the
+rundir round-trip that sample-time reconstruction depends on (reference
+launch.py:55-57, sample.py:49-65).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import typing as tp
+
+from midgpt_tpu.models.gpt import GPTConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical 3D device mesh. Axis sizes of -1 are inferred at runtime.
+
+    The reference hard-codes Mesh((n_devices // 8, 8), ('replica', 'data'))
+    (reference train.py:130) — i.e. batch over both axes, params over the
+    8-wide axis. Here the axes are named for their role: batch shards over
+    ('data', 'fsdp'), params over 'fsdp', and the sequence axis over 'sp'
+    (context parallelism; 1 unless ring attention is on).
+    """
+
+    data: int = -1  # -1: infer as n_devices // (fsdp * sp)
+    fsdp: int = 8
+    sp: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    rundir: str
+    data_dir: str
+    learning_rate: float
+    batch_size: int  # GLOBAL batch size across all devices
+    warmup_steps: int
+    min_lr: float
+    lr_decay_steps: int
+    max_steps: int
+    beta2: float
+    weight_decay: float
+    eval_interval: int
+    param_dtype: str  # 'float32'
+    compute_dtype: str  # 'bfloat16'
+    g_accum_iters: int
+    shard_model: bool
+    model_config: GPTConfig
+    mesh: MeshConfig = MeshConfig()
+    eval_steps: int = 200  # batches per eval (reference train.py:110)
+    log_interval: int = 20
+    seed: int = 0
+    data_seed: int = 1337  # seeded, resumable data sampler (reference has none)
+    fsdp_min_size: int = 2**18  # shard only params bigger than this (reference model.py:171)
+    debug: bool = False
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def to_json(config: ExperimentConfig) -> str:
+    return json.dumps(dataclasses.asdict(config), indent=2)
+
+
+_NESTED: tp.Dict[str, type] = {"model_config": GPTConfig, "mesh": MeshConfig}
+
+
+def from_json(text: str) -> ExperimentConfig:
+    raw = json.loads(text)
+    for name, cls in _NESTED.items():
+        if name in raw and isinstance(raw[name], dict):
+            known = {f.name for f in dataclasses.fields(cls)}
+            raw[name] = cls(**{k: v for k, v in raw[name].items() if k in known})
+    known = {f.name for f in dataclasses.fields(ExperimentConfig)}
+    return ExperimentConfig(**{k: v for k, v in raw.items() if k in known})
+
+
+def load_config(name: str) -> ExperimentConfig:
+    """Load a named preset from midgpt_tpu.configs (e.g. 'shakespeare_char')."""
+    module = importlib.import_module(f"midgpt_tpu.configs.{name}")
+    return module.config

@@ -1,0 +1,34 @@
+"""7B Llama-shape, seq 4096, 2D data x fsdp mesh + grad accum (BASELINE.json
+configs list). Long context uses the blockwise O(T) attention path (the Pallas
+flash kernel and ring-attention context parallelism take over as they land)."""
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPTConfig
+
+config = ExperimentConfig(
+    rundir="",
+    data_dir="/mnt/disks/persist/openwebtext",
+    learning_rate=3e-4,
+    batch_size=256,
+    warmup_steps=2000,
+    min_lr=3e-5,
+    lr_decay_steps=100_000,
+    max_steps=100_000,
+    beta2=0.95,
+    weight_decay=1e-4,
+    eval_interval=1000,
+    compute_dtype="bfloat16",
+    param_dtype="float32",
+    g_accum_iters=4,
+    shard_model=True,
+    mesh=MeshConfig(data=-1, fsdp=16, sp=1),
+    model_config=GPTConfig(
+        block_size=4096,
+        vocab_size=50304,
+        n_layer=32,
+        n_head=32,
+        n_embd=4096,
+        dropout=0.0,
+        attn_impl="blockwise",
+    ),
+)

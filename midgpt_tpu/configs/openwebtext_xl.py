@@ -1,0 +1,35 @@
+"""1.5B GPT-2-XL-ish, multihost FSDP (reference configs/openwebtext_xl.py:4-22).
+
+The headline benchmark config: reference hits ~2.42 val loss / ~444K tok/s /
+47.8% MFU on a v3-128 (BASELINE.md).
+"""
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPTConfig
+
+config = ExperimentConfig(
+    rundir="",
+    data_dir="/mnt/disks/persist/openwebtext",
+    learning_rate=1e-3,
+    batch_size=1024,
+    warmup_steps=2500,
+    min_lr=1e-5,
+    lr_decay_steps=25_000,
+    max_steps=25_000,
+    beta2=0.95,
+    weight_decay=1e-4,
+    eval_interval=1000,
+    compute_dtype="bfloat16",
+    param_dtype="float32",
+    g_accum_iters=1,
+    shard_model=True,
+    mesh=MeshConfig(data=-1, fsdp=8, sp=1),
+    model_config=GPTConfig(
+        block_size=1024,
+        vocab_size=50304,
+        n_layer=24,
+        n_head=16,
+        n_embd=2048,
+        dropout=0.0,
+    ),
+)

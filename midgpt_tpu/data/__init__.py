@@ -1,0 +1,3 @@
+from midgpt_tpu.data.dataset import TokenDataset, sample_batch
+
+__all__ = ["TokenDataset", "sample_batch"]

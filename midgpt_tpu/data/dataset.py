@@ -1,0 +1,110 @@
+"""Token-stream dataset: uint16 memmap bins + seeded random-window sampling.
+
+Format-compatible with the reference/nanoGPT pipeline: `train.bin`/`val.bin`
+flat uint16 token streams, plus optional `meta.pkl` char codec (reference
+train.py:56-66,132-137; data/*/prepare.py).
+
+Two deliberate upgrades over the reference:
+  * **Seeded, resumable sampling.** The reference draws from the unseeded
+    global numpy RNG (reference train.py:60), so resumed runs replay nothing.
+    Here every batch is drawn from `np.random.default_rng([seed, split, step])`
+    — stateless, deterministic, and exactly replayable after restore with no
+    sampler state to checkpoint.
+  * **Optional RAM copy.** The reference always copies the full 17GB stream
+    into host RAM (train.py:132-133). `in_ram=False` keeps the memmap and
+    lets the page cache do its job.
+
+When the native batcher extension is built (midgpt_tpu/runtime), the gather
+loop runs in threaded C++ with prefetch; this module is the always-available
+fallback with identical output.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import typing as tp
+
+import numpy as np
+
+_SPLIT_IDS = {"train": 0, "val": 1}
+
+
+def sample_batch(
+    data: np.ndarray,
+    block_size: int,
+    batch_size: int,
+    g_accum_iters: tp.Optional[int] = None,
+    *,
+    rng: tp.Optional[np.random.Generator] = None,
+) -> tp.Tuple[np.ndarray, np.ndarray]:
+    """Random (x, y=x shifted by one) windows, int32.
+
+    Shapes: (B, T) or (G, B, T) when g_accum_iters is given (reference
+    train.py:56-66).
+    """
+    rng = rng or np.random.default_rng()
+    bs = batch_size * (g_accum_iters or 1)
+    starts = rng.integers(0, len(data) - block_size, size=(bs,))
+    offsets = np.arange(block_size)
+    x = data[starts[:, None] + offsets].astype(np.int32)
+    y = data[starts[:, None] + offsets + 1].astype(np.int32)
+    if g_accum_iters is not None:
+        x = x.reshape(g_accum_iters, batch_size, block_size)
+        y = y.reshape(g_accum_iters, batch_size, block_size)
+    return x, y
+
+
+class TokenDataset:
+    """train/val uint16 streams from `data_dir`, sliced per host."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        *,
+        in_ram: bool = True,
+        seed: int = 1337,
+        shard_by_process: bool = False,
+    ):
+        """shard_by_process: give this host a contiguous 1/n_proc slice of
+        EACH split (sized per split — reference train.py:122-136)."""
+        self.data_dir = data_dir
+        self.seed = seed
+        self.splits: tp.Dict[str, np.ndarray] = {}
+        for split in ("train", "val"):
+            path = os.path.join(data_dir, f"{split}.bin")
+            arr = np.memmap(path, dtype=np.uint16, mode="r")
+            if shard_by_process:
+                import jax
+
+                n_proc, idx = jax.process_count(), jax.process_index()
+                per = len(arr) // n_proc + 1
+                arr = arr[idx * per : (idx + 1) * per]
+            if in_ram:
+                arr = np.ascontiguousarray(arr)
+            self.splits[split] = arr
+
+    def __getitem__(self, split: str) -> np.ndarray:
+        return self.splits[split]
+
+    def batch(
+        self,
+        split: str,
+        step: int,
+        block_size: int,
+        batch_size: int,
+        g_accum_iters: tp.Optional[int] = None,
+    ) -> tp.Tuple[np.ndarray, np.ndarray]:
+        """Deterministic batch for (split, step): resumable by construction."""
+        rng = np.random.default_rng([self.seed, _SPLIT_IDS[split], step])
+        return sample_batch(
+            self.splits[split], block_size, batch_size, g_accum_iters, rng=rng
+        )
+
+    def meta(self) -> tp.Optional[dict]:
+        """Char-codec metadata if present (shakespeare_char)."""
+        path = os.path.join(self.data_dir, "meta.pkl")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)

@@ -1,0 +1,3 @@
+from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams
+
+__all__ = ["GPT", "GPTConfig", "GPTParams"]

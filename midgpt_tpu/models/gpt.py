@@ -1,0 +1,231 @@
+"""Decoder-only GPT as a plain pytree + pure functions, TPU-first.
+
+Architecture parity with the reference (for val-loss parity; see SURVEY.md §7):
+  * pre-norm residual blocks with *weightless* RMSNorm (eps 1e-6 in blocks,
+    1e-5 for the final norm — reference model.py:94-95,133)
+  * fused QKV projection, QK-LayerNorm per head (learned scale, no bias,
+    eps 1e-6 — reference model.py:52-53,64-65)
+  * GPT-J interleaved rotary embeddings (reference layers.py:79-99)
+  * bias-free Linears, truncated-normal(±2σ)/sqrt(fan_in) init (reference
+    layers.py:49-50); embedding init N(0, 1/sqrt(D)) (reference model.py:134)
+  * init-only weight tying: wte and lm_head start from the same array but are
+    independent leaves that diverge from step 1 (reference model.py:135-138)
+  * GELU MLP with 4x expansion (reference model.py:17-31)
+  * fp32 softmax inside attention; logits returned in compute dtype and cast
+    to fp32 by the loss (reference model.py:74-77, train.py:76)
+
+TPU-first structure (different from the reference's Equinox modules):
+  * Block parameters are stacked along a leading layer axis; the forward pass
+    is ONE `jax.lax.scan` over that axis with `jax.checkpoint` per block
+    (compile time O(1) in depth, remat bounds activation memory). The
+    reference reaches the same shape via eqx.filter_vmap + filter scan
+    (model.py:130-132,149-155); here it is the native representation.
+  * The forward runs on a full (B, T) batch — batch semantics live in the
+    model, not an outer vmap, so sharding constraints and Pallas kernels see
+    the batched shapes they tile over.
+  * Everything is shape-static and key-explicit: jit-safe by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.ops.attention import multihead_attention
+from midgpt_tpu.ops.dropout import dropout
+from midgpt_tpu.ops.norms import head_layer_norm, rms_norm
+from midgpt_tpu.ops.rope import apply_rope, rope_table
+from midgpt_tpu.utils.pytree import pytree_dataclass
+
+Array = jax.Array
+KeyArray = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Model shape (mirrors reference model.py:108-115)."""
+
+    block_size: int  # max sequence length
+    vocab_size: int
+    n_layer: int
+    n_head: int
+    n_embd: int
+    dropout: float = 0.0
+    # TPU knobs (not part of the reference config surface):
+    attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash'
+    attn_block_size: int = 512  # tile size for blockwise/flash paths
+    remat: bool = True  # checkpoint each block inside the layer scan
+    scan_unroll: int = 1  # unroll factor of the layer scan
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+
+@pytree_dataclass
+class AttentionParams:
+    wqkv: Array  # (3D, D) fused QKV projection, applied as W @ x
+    wo: Array  # (D, D) output projection
+    q_scale: Array  # (C,) QK-LayerNorm scale for queries
+    k_scale: Array  # (C,) QK-LayerNorm scale for keys
+
+
+@pytree_dataclass
+class MLPParams:
+    w_up: Array  # (4D, D)
+    w_down: Array  # (D, 4D)
+
+
+@pytree_dataclass
+class BlockParams:
+    attn: AttentionParams
+    mlp: MLPParams
+    # Block RMSNorms are weightless (reference model.py:94-95): no leaves.
+
+
+@pytree_dataclass
+class GPTParams:
+    wte: Array  # (V, D) token embedding
+    blocks: BlockParams  # every leaf stacked with leading (n_layer,) axis
+    lm_head: Array  # (V, D), applied as x @ lm_head.T; init-tied to wte
+
+
+def _linear_init(key: KeyArray, out_features: int, in_features: int) -> Array:
+    """Truncated-normal(±2σ) scaled 1/sqrt(fan_in) (reference layers.py:49-50)."""
+    w = jax.random.truncated_normal(key, -2.0, 2.0, (out_features, in_features))
+    return w / math.sqrt(in_features)
+
+
+class GPT:
+    """Namespace of pure functions over (GPTConfig, GPTParams)."""
+
+    @staticmethod
+    def init(config: GPTConfig, key: KeyArray) -> GPTParams:
+        block_key, embed_key = jax.random.split(key)
+        D, C = config.n_embd, config.head_dim
+
+        def init_block(k: KeyArray) -> BlockParams:
+            k_attn, k_proj, k_up, k_down = jax.random.split(k, 4)
+            attn = AttentionParams(
+                wqkv=_linear_init(k_attn, 3 * D, D),
+                wo=_linear_init(k_proj, D, D),
+                q_scale=jnp.ones((C,)),
+                k_scale=jnp.ones((C,)),
+            )
+            mlp = MLPParams(
+                w_up=_linear_init(k_up, 4 * D, D),
+                w_down=_linear_init(k_down, D, 4 * D),
+            )
+            return BlockParams(attn=attn, mlp=mlp)
+
+        blocks = jax.vmap(init_block)(jax.random.split(block_key, config.n_layer))
+        embed = jax.random.normal(embed_key, (config.vocab_size, D)) / math.sqrt(D)
+        # Init-only tying: same values, independent leaves (reference model.py:135-138).
+        return GPTParams(wte=embed, blocks=blocks, lm_head=embed)
+
+    @staticmethod
+    def block_apply(
+        config: GPTConfig,
+        params: BlockParams,
+        x: Array,  # (B, T, D)
+        *,
+        key: tp.Optional[KeyArray] = None,
+        inference: bool = False,
+        rope: tp.Optional[tp.Tuple[Array, Array]] = None,
+        positions: tp.Optional[Array] = None,
+    ) -> Array:
+        B, T, D = x.shape
+        H, C = config.n_head, config.head_dim
+        if rope is None:
+            rope = rope_table(C, T)
+        sin, cos = rope
+        if key is not None:
+            k_attn_drop, k_resid, k_mlp = jax.random.split(key, 3)
+        else:
+            k_attn_drop = k_resid = k_mlp = None
+
+        # --- attention sublayer ---
+        h = rms_norm(x)  # weightless, eps 1e-6
+        qkv = jnp.einsum("btd,ed->bte", h, params.attn.wqkv)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, C).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, H, C).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, H, C).transpose(0, 2, 1, 3)
+        q = head_layer_norm(q, params.attn.q_scale)
+        k = head_layer_norm(k, params.attn.k_scale)
+        q = apply_rope(q, sin, cos, positions)
+        k = apply_rope(k, sin, cos, positions)
+        att = multihead_attention(
+            q,
+            k,
+            v,
+            impl=config.attn_impl,
+            dropout_rate=config.dropout,
+            key=k_attn_drop,
+            inference=inference,
+            block_size=config.attn_block_size,
+        )
+        att = att.transpose(0, 2, 1, 3).reshape(B, T, D)
+        att = jnp.einsum("btd,ed->bte", att, params.attn.wo)
+        att = dropout(att, config.dropout, k_resid, inference)
+        x = x + att
+
+        # --- MLP sublayer ---
+        h = rms_norm(x)
+        h = jax.nn.gelu(jnp.einsum("btd,ed->bte", h, params.mlp.w_up))
+        h = jnp.einsum("bte,de->btd", h, params.mlp.w_down)
+        h = dropout(h, config.dropout, k_mlp, inference)
+        return x + h
+
+    @staticmethod
+    def apply(
+        config: GPTConfig,
+        params: GPTParams,
+        tokens: Array,  # (B, T) int
+        *,
+        key: tp.Optional[KeyArray] = None,
+        inference: bool = False,
+    ) -> Array:
+        """Forward pass -> logits (B, T, V) in the params' floating dtype."""
+        B, T = tokens.shape
+        C = config.head_dim
+        if key is not None:
+            drop_key, layers_key = jax.random.split(key)
+            layer_keys = jax.random.split(layers_key, config.n_layer)
+        else:
+            drop_key, layer_keys = None, None
+
+        x = jnp.take(params.wte, tokens, axis=0)  # (B, T, D)
+        x = dropout(x, config.dropout, drop_key, inference)
+
+        rope = rope_table(C, T)  # shared fp32 table, constant-folded under jit
+
+        def block_fn(x, block_and_key):
+            block, k = block_and_key
+            return (
+                GPT.block_apply(
+                    config, block, x, key=k, inference=inference, rope=rope
+                ),
+                None,
+            )
+
+        if config.remat:
+            block_fn = jax.checkpoint(block_fn)
+        x, _ = jax.lax.scan(
+            block_fn, x, (params.blocks, layer_keys), unroll=config.scan_unroll
+        )
+
+        x = rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
+        return jnp.einsum("btd,vd->btv", x, params.lm_head)
+
+    @staticmethod
+    def count_params(params: GPTParams) -> int:
+        """Parameter count excluding the duplicated tied embedding
+        (reference model.py:161-164)."""
+        total = sum(x.size for x in jax.tree.leaves(params))
+        return total - params.lm_head.size

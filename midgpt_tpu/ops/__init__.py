@@ -1,0 +1,16 @@
+from midgpt_tpu.ops.norms import rms_norm, head_layer_norm
+from midgpt_tpu.ops.rope import rope_table, apply_rope, rotate_interleaved
+from midgpt_tpu.ops.dropout import dropout
+from midgpt_tpu.ops.loss import cross_entropy_loss
+from midgpt_tpu.ops.attention import multihead_attention
+
+__all__ = [
+    "rms_norm",
+    "head_layer_norm",
+    "rope_table",
+    "apply_rope",
+    "rotate_interleaved",
+    "dropout",
+    "cross_entropy_loss",
+    "multihead_attention",
+]

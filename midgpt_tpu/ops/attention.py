@@ -1,0 +1,142 @@
+"""Causal multi-head attention: reference-exact naive path + O(T) blockwise path.
+
+Numerics of the naive path match reference model.py:71-77 exactly: scores are
+computed in the compute dtype (bf16 on TPU — this matmul is the MXU hot op),
+cast to float32, scaled by 1/sqrt(head_dim), masked with -inf below the
+diagonal, softmaxed in float32, then cast back for the PV matmul.
+
+The blockwise path (`impl='blockwise'`) is a pure-jnp online-softmax
+(flash-style) formulation with O(T) memory — the long-context fallback for
+platforms where the Pallas kernel (midgpt_tpu.kernels.flash_attention,
+`impl='flash'`) is unavailable, and the parity oracle for testing it.
+
+All impls take q, k, v of shape (B, H, T, C) and return (B, H, T, C).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.ops.dropout import dropout
+
+Array = jax.Array
+
+NEG_INF = float("-inf")
+
+
+def naive_causal_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    dropout_rate: float = 0.0,
+    key: tp.Optional[Array] = None,
+    inference: bool = True,
+) -> Array:
+    """Materialized-scores attention, fp32 softmax. (B,H,T,C) -> (B,H,T,C)."""
+    *_, T, C = q.shape
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q, k)
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32) / math.sqrt(C), axis=-1)
+    probs = probs.astype(q.dtype)
+    probs = dropout(probs, dropout_rate, key, inference)
+    return jnp.einsum("bhqk,bhkc->bhqc", probs, v)
+
+
+def blockwise_causal_attention(
+    q: Array, k: Array, v: Array, block_size: int = 512
+) -> Array:
+    """Online-softmax causal attention with O(T * block) memory.
+
+    Scans over KV blocks for each Q block, keeping running (max, sum, acc)
+    statistics in float32. Equivalent to the naive path up to fp summation
+    order. Block pairs entirely above the diagonal are masked out (compute is
+    not skipped — under `lax.scan` the shape must be static; the Pallas kernel
+    is the one that actually skips them).
+    """
+    B, H, T, C = q.shape
+    blk = min(block_size, T)
+    if T % blk != 0:
+        raise ValueError(f"seq len {T} must be divisible by block size {blk}")
+    n_blk = T // blk
+    scale = 1.0 / math.sqrt(C)
+
+    qb = q.reshape(B, H, n_blk, blk, C)
+    kb = k.reshape(B, H, n_blk, blk, C)
+    vb = v.reshape(B, H, n_blk, blk, C)
+
+    # Row/col indices within a (blk, blk) tile, used to build per-pair masks.
+    row_ids = jnp.arange(blk)[:, None]
+    col_ids = jnp.arange(blk)[None, :]
+
+    def q_block_fn(qi: int, q_i: Array) -> Array:
+        # q_i: (B, H, blk, C)
+        def kv_step(carry, j):
+            acc, m, denom = carry  # (B,H,blk,C) f32, (B,H,blk) f32, (B,H,blk) f32
+            k_j = kb[:, :, j]
+            v_j = vb[:, :, j]
+            s = jnp.einsum("bhqc,bhkc->bhqk", q_i, k_j).astype(jnp.float32) * scale
+            # causal mask: global query index >= global key index
+            gmask = (qi * blk + row_ids) >= (j * blk + col_ids)
+            s = jnp.where(gmask & (j <= qi), s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            # guard: fully-masked rows keep m_new == -inf; exp(-inf - -inf) → use where
+            alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            denom_new = denom * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkc->bhqc", p.astype(q.dtype), v_j
+            ).astype(jnp.float32)
+            return (acc_new, m_new, denom_new), None
+
+        init = (
+            jnp.zeros((B, H, blk, C), jnp.float32),
+            jnp.full((B, H, blk), NEG_INF, jnp.float32),
+            jnp.zeros((B, H, blk), jnp.float32),
+        )
+        (acc, _, denom), _ = jax.lax.scan(kv_step, init, jnp.arange(n_blk))
+        return (acc / denom[..., None]).astype(q.dtype)
+
+    outs = [q_block_fn(qi, qb[:, :, qi]) for qi in range(n_blk)]
+    return jnp.stack(outs, axis=2).reshape(B, H, T, C)
+
+
+def multihead_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    impl: str = "naive",
+    dropout_rate: float = 0.0,
+    key: tp.Optional[Array] = None,
+    inference: bool = False,
+    block_size: int = 512,
+) -> Array:
+    """Dispatch causal attention over (B, H, T, C) tensors.
+
+    impl: 'naive' (materialized T×T, reference semantics), 'blockwise'
+    (O(T) jnp online softmax), or 'flash' (Pallas TPU kernel).
+    Attention-probability dropout (reference model.py:78) is only supported
+    on the naive path; the fused kernels take dropout_rate == 0 (all
+    openwebtext-scale reference configs train with dropout 0.0).
+    """
+    if impl == "naive":
+        return naive_causal_attention(
+            q, k, v, dropout_rate=dropout_rate, key=key, inference=inference
+        )
+    if dropout_rate != 0.0 and not inference:
+        raise NotImplementedError(f"attention dropout requires impl='naive', got {impl!r}")
+    if impl == "blockwise":
+        return blockwise_causal_attention(q, k, v, block_size=block_size)
+    if impl == "flash":
+        from midgpt_tpu.kernels.flash_attention import flash_attention
+
+        return flash_attention(q, k, v)
+    raise ValueError(f"unknown attention impl {impl!r}")

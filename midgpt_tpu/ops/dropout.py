@@ -1,0 +1,20 @@
+"""Keyed dropout as a pure function (reference uses eqx.nn.Dropout)."""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def dropout(x: Array, rate: float, key: tp.Optional[Array], inference: bool = False) -> Array:
+    if inference or rate == 0.0:
+        return x
+    if key is None:
+        raise ValueError("dropout(rate>0, inference=False) requires a PRNG key")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

@@ -1,0 +1,23 @@
+"""Softmax cross-entropy with integer labels, computed in float32.
+
+Matches reference train.py:72-77: logits are cast to float32 before the loss
+(bf16 logits would lose too much precision in the logsumexp), and the result
+is the mean over all positions. Implemented directly (no optax dependency in
+the ops layer) with the standard stable logsumexp formulation — XLA fuses this
+with the lm_head matmul's epilogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def cross_entropy_loss(logits: Array, labels: Array) -> Array:
+    """Mean CE over all positions. logits (..., V) any float dtype, labels (...) ints."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - label_logits)

@@ -1,0 +1,40 @@
+"""Normalization ops.
+
+Semantics match the reference for val-loss parity:
+  * `rms_norm` — weightless by default (reference layers.py:60-75 with
+    use_weight=False everywhere it is instantiated: block norms and final
+    norm, reference model.py:94-95,133). Reduction in the input dtype, like
+    the reference.
+  * `head_layer_norm` — QK-LayerNorm over the head dim: true LayerNorm (mean
+    subtraction) with a learned scale, no bias, eps 1e-6 (reference
+    model.py:52-53).
+
+Both are elementwise+reduction ops XLA fuses into the surrounding matmuls, so
+there is no dedicated Pallas kernel for the default path; a fused variant
+lives in the flash-attention kernel where it rides the same VMEM tile.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def rms_norm(x: Array, weight: tp.Optional[Array] = None, eps: float = 1e-6) -> Array:
+    """RMS-normalize over the trailing axis. Weightless unless `weight` given."""
+    out = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def head_layer_norm(x: Array, weight: Array, eps: float = 1e-6) -> Array:
+    """LayerNorm over the trailing (head) axis with scale, no bias."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    centered = x - mean
+    var = jnp.mean(jnp.square(centered), axis=-1, keepdims=True)
+    return centered * jax.lax.rsqrt(var + eps) * weight

@@ -1,0 +1,63 @@
+"""Rotary position embeddings, GPT-J interleaved style.
+
+Matches reference layers.py:79-99: pairs are interleaved ([a b c d] rotates to
+[-b a -d c]), the sin/cos tables use base 10000 over even channel indices, and
+the table is duplicated across each pair so rotation is applied at full head
+dim. The table is computed in float32 with jnp (constant-folded by XLA under
+jit for static T — the reference computes it in host numpy, reference
+layers.py:79-82, which is the same thing after tracing) and cast to the
+activation dtype at the point of use.
+
+`positions` is explicit so the KV-cache decode path can rotate a single new
+token at its absolute position.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def rope_table(head_dim: int, length: int, base: float = 10000.0) -> tp.Tuple[Array, Array]:
+    """(sin, cos) tables of shape (length, head_dim // 2), float32."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = jnp.arange(length, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def rotate_interleaved(x: Array) -> Array:
+    """[a b c d] -> [-b a -d c] over the trailing axis."""
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack((-x2, x1), axis=-1).reshape(x.shape)
+
+
+def _duplicate_pairs(t: Array) -> Array:
+    """(..., C/2) -> (..., C) by repeating each element twice (interleaved)."""
+    return jnp.stack((t, t), axis=-1).reshape(t.shape[:-1] + (t.shape[-1] * 2,))
+
+
+def apply_rope(
+    x: Array,
+    sin: Array,
+    cos: Array,
+    positions: tp.Optional[Array] = None,
+) -> Array:
+    """Rotate `x` (..., T, head_dim) by the (sin, cos) tables.
+
+    If `positions` (shape (T,)) is given, rows of the tables are gathered at
+    those absolute positions; otherwise the first T rows are used.
+    """
+    if positions is not None:
+        sin = jnp.take(sin, positions, axis=0)
+        cos = jnp.take(cos, positions, axis=0)
+    else:
+        sin = sin[: x.shape[-2]]
+        cos = cos[: x.shape[-2]]
+    sin = _duplicate_pairs(sin).astype(x.dtype)
+    cos = _duplicate_pairs(cos).astype(x.dtype)
+    return x * cos + rotate_interleaved(x) * sin

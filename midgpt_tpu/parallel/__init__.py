@@ -1,0 +1,12 @@
+from midgpt_tpu.parallel.mesh import make_mesh, batch_spec
+from midgpt_tpu.parallel.fsdp import fsdp_param_specs, constrain, named_shardings
+from midgpt_tpu.parallel.data import make_global_batch
+
+__all__ = [
+    "make_mesh",
+    "batch_spec",
+    "fsdp_param_specs",
+    "constrain",
+    "named_shardings",
+    "make_global_batch",
+]

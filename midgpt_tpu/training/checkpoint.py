@@ -1,0 +1,73 @@
+"""Async Orbax checkpointing with a *named* state tree.
+
+Upgrades over the reference, which saves bare `tree_leaves` tuples
+(reference train.py:215) so restore requires rebuilding the exact tree
+structure in code (reference sample.py:111-137 reconstructs the whole
+optimizer chain just to get a skeleton):
+
+  * state is a named dict {"params": ..., "opt_state": ...} serialized by
+    key path — robust to incidental structure changes and readable by tools;
+  * restore is sharding-aware: each host reads only its shards, directly
+    into the live arrays' shardings (same property as reference
+    train.py:179-187);
+  * saves are async (training continues during the TensorStore write), with
+    a final barrier on close (reference train.py:224-225).
+
+Works on local paths and gs:// rundirs alike (TensorStore handles both).
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _abstract_like(tree: tp.Any) -> tp.Any:
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(conv, tree)
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 1,
+        save_interval_steps: int = 1000,
+    ):
+        if not directory.startswith("gs://"):
+            import os
+
+            directory = os.path.abspath(directory)  # TensorStore requires absolute
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=True,
+        )
+        self._mngr = ocp.CheckpointManager(directory, options=options)
+
+    def latest_step(self) -> tp.Optional[int]:
+        return self._mngr.latest_step()
+
+    def save(self, step: int, state: tp.Any, *, force: bool = False) -> bool:
+        """Queue an async save; the manager filters by save_interval_steps
+        unless `force` (used for the final step of a run)."""
+        return self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, step: int, like: tp.Any) -> tp.Any:
+        """Restore into the structure/shardings of `like` (live or abstract)."""
+        abstract = _abstract_like(like)
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()

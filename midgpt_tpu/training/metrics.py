@@ -1,0 +1,120 @@
+"""Observability: metrics logging, throughput/MFU accounting, profiler hooks.
+
+Mirrors the reference's surface (wandb + tqdm postfix + jax.profiler,
+reference train.py:191-220, launch.py:38-68) but degrades gracefully: wandb
+is optional (proc-0 only when present), and every metric always lands in
+`rundir/metrics.jsonl` + stdout so headless TPU runs are inspectable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import typing as tp
+
+import jax
+
+from midgpt_tpu.config import ExperimentConfig
+from midgpt_tpu.models.gpt import GPTConfig
+
+try:  # wandb is an optional dependency
+    import wandb as _wandb
+except Exception:  # pragma: no cover - depends on environment
+    _wandb = None
+
+
+def flops_per_token(cfg: GPTConfig, seq_len: tp.Optional[int] = None) -> float:
+    """Training FLOPs/token: 6N for the matmuls (fwd 2N + bwd 4N) plus the
+    12*L*D*T attention-scores term (PaLM appendix B accounting)."""
+    T = seq_len or cfg.block_size
+    D, L, V = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    n_params = V * D + L * (12 * D * D + 2 * cfg.head_dim) + V * D
+    # Count the tied embedding once, like reference count_params (model.py:161).
+    n_params -= V * D
+    return 6.0 * n_params + 12.0 * L * D * T
+
+
+# Peak bf16 TFLOP/s per chip by TPU generation (public figures).
+_PEAK_FLOPS = {
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+}
+
+
+def device_peak_flops(device: tp.Optional[jax.Device] = None) -> tp.Optional[float]:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for name, flops in _PEAK_FLOPS.items():
+        if name in kind:
+            return flops
+    return None
+
+
+def mfu(tokens_per_sec: float, cfg: GPTConfig, n_devices: int) -> tp.Optional[float]:
+    peak = device_peak_flops()
+    if peak is None:
+        return None
+    return tokens_per_sec * flops_per_token(cfg) / (peak * n_devices)
+
+
+class MetricLogger:
+    """jsonl + stdout always; wandb when available (proc 0 only)."""
+
+    def __init__(self, config: ExperimentConfig, *, use_wandb: bool = True, resume_id: tp.Optional[str] = None):
+        self.is_main = jax.process_index() == 0
+        self.rundir = config.rundir
+        self._file = None
+        self._wandb = None
+        if self.is_main and self.rundir and not self.rundir.startswith("gs://"):
+            os.makedirs(self.rundir, exist_ok=True)
+            self._file = open(os.path.join(self.rundir, "metrics.jsonl"), "a")
+        if self.is_main and use_wandb and _wandb is not None and not config.debug:
+            import dataclasses
+
+            self._wandb = _wandb.init(
+                project="midgpt-tpu",
+                id=resume_id,
+                resume="allow",
+                config=dataclasses.asdict(config),
+            )
+
+    def log(self, step: int, metrics: tp.Dict[str, float]) -> None:
+        if not self.is_main:
+            return
+        record = {"step": step, "time": time.time(), **metrics}
+        if self._file is not None:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+class Profiler:
+    """One-shot trace of the first post-warmup step (reference train.py:205-211)."""
+
+    def __init__(self, rundir: str, enabled: bool):
+        self.rundir, self.enabled, self._active = rundir, enabled, False
+
+    def maybe_start(self, step: int, at_step: int = 0) -> None:
+        if self.enabled and step == at_step:
+            jax.profiler.start_trace(self.rundir or "/tmp/midgpt_trace")
+            self._active = True
+
+    def maybe_stop(self, wait_for: tp.Any = None) -> None:
+        if self._active:
+            if wait_for is not None:
+                jax.block_until_ready(wait_for)
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,249 @@
+"""Training runtime: one compiled SPMD step + the host-side experiment loop.
+
+Step semantics match the reference hot path (reference train.py:69-97) for
+val-loss parity:
+  * fp32 master params, cast to the compute dtype (bf16) once per step;
+  * `lax.scan` over `g_accum_iters` microbatches, each microgradient
+    re-constrained to the FSDP layout (so accumulation happens *sharded* —
+    GSPMD reduce-scatters each microstep, reference train.py:87) and summed
+    into an fp32 accumulator; summed loss averaged, grads divided by G;
+  * optax update + apply, params re-constrained, buffers donated.
+
+The whole step — microbatching, collectives, optimizer — is ONE XLA program
+(jit with donate_argnums), executing identically on every device of every
+host. Eval runs `eval_steps` fresh seeded batches at compute dtype with
+dropout off (reference train.py:99-117).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from midgpt_tpu.config import ExperimentConfig
+from midgpt_tpu.data.dataset import TokenDataset
+from midgpt_tpu.models.gpt import GPT, GPTParams
+from midgpt_tpu.ops.loss import cross_entropy_loss
+from midgpt_tpu.parallel.data import make_global_batch
+from midgpt_tpu.parallel.fsdp import constrain, fsdp_param_specs, named_shardings
+from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
+from midgpt_tpu.training.checkpoint import CheckpointManager
+from midgpt_tpu.training.metrics import MetricLogger, Profiler, mfu
+from midgpt_tpu.training.optim import make_optimizer, make_schedule
+
+Array = jax.Array
+
+
+def make_train_step(
+    config: ExperimentConfig,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    param_specs,
+) -> tp.Tuple[tp.Callable, tp.Callable]:
+    """Build (step, eval_loss) jitted functions."""
+    model_cfg = config.model_config
+    compute_dtype = jnp.dtype(config.compute_dtype)
+    G = config.g_accum_iters
+
+    def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
+        logits = GPT.apply(model_cfg, params_c, x, key=key, inference=False)
+        return cross_entropy_loss(logits, y)
+
+    def cast_compute(params: GPTParams) -> GPTParams:
+        return jax.tree.map(
+            lambda p: p.astype(compute_dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params: GPTParams, opt_state, x_GBT: Array, y_GBT: Array, key):
+        params_c = cast_compute(params)
+
+        def microstep(grad_acc, xyk):
+            x, y, k = xyk
+            loss, grad = jax.value_and_grad(loss_fn)(params_c, x, y, k)
+            grad = constrain(grad, param_specs, mesh)
+            grad_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype), grad_acc, grad)
+            return grad_acc, loss
+
+        keys = jax.random.split(key, G)
+        grad_init = jax.tree.map(jnp.zeros_like, params)
+        grad, losses = jax.lax.scan(microstep, grad_init, (x_GBT, y_GBT, keys))
+        grad = jax.tree.map(lambda g: g / G, grad)
+        updates, opt_state = optimizer.update(grad, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = constrain(params, param_specs, mesh)
+        return params, opt_state, jnp.mean(losses)
+
+    @jax.jit
+    def eval_loss(params: GPTParams, x: Array, y: Array) -> Array:
+        logits = GPT.apply(model_cfg, cast_compute(params), x, inference=True)
+        return cross_entropy_loss(logits, y)
+
+    return step, eval_loss
+
+
+def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp.Any, tp.Any]:
+    """Sharded-at-birth params + optimizer state (never materialized dense).
+
+    Returns (params, opt_state, param_specs, optimizer)."""
+    optimizer, _ = make_optimizer(config)
+    abstract_params = jax.eval_shape(
+        lambda k: GPT.init(config.model_config, k), jax.random.PRNGKey(0)
+    )
+    param_specs = fsdp_param_specs(
+        abstract_params, mesh, config.shard_model, config.fsdp_min_size
+    )
+
+    def init_fn(key):
+        params = GPT.init(config.model_config, key)
+        params = jax.tree.map(lambda p: p.astype(jnp.dtype(config.param_dtype)), params)
+        return constrain(params, param_specs, mesh)
+
+    params = jax.jit(init_fn)(jax.random.PRNGKey(config.seed))
+
+    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+    opt_specs = fsdp_param_specs(
+        abstract_opt, mesh, config.shard_model, config.fsdp_min_size
+    )
+    opt_state = jax.jit(
+        optimizer.init, out_shardings=named_shardings(opt_specs, mesh)
+    )(params)
+    return params, opt_state, param_specs, optimizer
+
+
+def evaluate(
+    config: ExperimentConfig,
+    eval_loss: tp.Callable,
+    params: GPTParams,
+    dataset: TokenDataset,
+    split: str,
+    mesh,
+    step_idx: int,
+) -> float:
+    spec = batch_spec(with_accum=False)
+    n = 1 if config.debug else config.eval_steps
+    total = 0.0
+    for i in range(n):
+        x, y = dataset.batch(
+            split,
+            # decorrelate eval batches from train batches and across evals
+            1_000_000_000 + step_idx * n + i,
+            config.model_config.block_size,
+            config.batch_size // jax.process_count(),
+        )
+        xg = make_global_batch(x, mesh, spec)
+        yg = make_global_batch(y, mesh, spec)
+        total += float(eval_loss(params, xg, yg))
+    return total / n
+
+
+def train(config: ExperimentConfig) -> dict:
+    """Run the experiment; returns final metrics (for tests/benches)."""
+    mesh = make_mesh(config.mesh)
+    n_proc = jax.process_count()
+    assert config.batch_size % n_proc == 0, "global batch must divide process count"
+    local_bs = config.batch_size // n_proc
+
+    dataset = TokenDataset(
+        config.data_dir, seed=config.data_seed, shard_by_process=n_proc > 1
+    )
+
+    params, opt_state, param_specs, optimizer = init_state(config, mesh)
+    schedule = make_schedule(config)
+    step, eval_loss = make_train_step(config, optimizer, mesh, param_specs)
+    n_params = GPT.count_params(params)
+    if jax.process_index() == 0:
+        print(f"Model has {n_params:,} parameters.")
+
+    mngr = None
+    first_step = 0
+    if not config.debug and config.rundir:
+        mngr = CheckpointManager(
+            config.rundir,
+            max_to_keep=1,
+            save_interval_steps=config.eval_interval,
+        )
+        if mngr.latest_step() is not None:
+            state = mngr.restore(
+                mngr.latest_step(), {"params": params, "opt_state": opt_state}
+            )
+            params, opt_state = state["params"], state["opt_state"]
+            first_step = mngr.latest_step() + 1
+
+    logger = MetricLogger(config)
+    profiler = Profiler(config.rundir, enabled=config.debug)
+    data_sp = batch_spec(with_accum=True)
+    key = jax.random.PRNGKey(config.seed)
+    T = config.model_config.block_size
+    metrics: tp.Dict[str, float] = {}
+    import time as _time
+
+    t_last, tokens_since = _time.time(), 0
+    for itr in range(first_step, config.max_steps):
+        if itr % config.eval_interval == 0:
+            metrics["loss/train"] = evaluate(
+                config, eval_loss, params, dataset, "train", mesh, itr
+            )
+            metrics["loss/val"] = evaluate(
+                config, eval_loss, params, dataset, "val", mesh, itr
+            )
+            logger.log(itr, {k: metrics[k] for k in ("loss/train", "loss/val")})
+            t_last, tokens_since = _time.time(), 0  # eval pauses don't count
+
+        x, y = dataset.batch("train", itr, T, local_bs, config.g_accum_iters)
+        xg = make_global_batch(x, mesh, data_sp)
+        yg = make_global_batch(y, mesh, data_sp)
+        key, step_key = jax.random.split(key)
+        profiler.maybe_start(itr, at_step=first_step + 1)
+        params, opt_state, loss = step(params, opt_state, xg, yg, step_key)
+        profiler.maybe_stop(wait_for=loss)
+
+        tokens_since += config.batch_size * config.g_accum_iters * T
+        if itr % config.log_interval == 0:
+            loss_f = float(loss)
+            dt = _time.time() - t_last
+            tok_s = tokens_since / dt if dt > 0 else 0.0
+            t_last, tokens_since = _time.time(), 0
+            metrics.update(
+                {
+                    "loss/optimized": loss_f,
+                    "lr": float(schedule(itr)),
+                    "throughput/tokens_per_sec": tok_s,
+                }
+            )
+            m = mfu(tok_s, config.model_config, jax.device_count())
+            if m is not None:
+                metrics["throughput/mfu"] = m
+            logger.log(itr, dict(metrics))
+            if jax.process_index() == 0:
+                print(
+                    f"step {itr}: loss {loss_f:.4f} lr {metrics['lr']:.2e} "
+                    f"tok/s {tok_s:,.0f}"
+                )
+        if mngr is not None:
+            mngr.save(itr, {"params": params, "opt_state": opt_state})
+
+    metrics["loss/final"] = float(
+        evaluate(config, eval_loss, params, dataset, "val", mesh, config.max_steps)
+    )
+    logger.log(config.max_steps, {"loss/val_final": metrics["loss/final"]})
+    logger.close()
+    if mngr is not None:
+        # Force-persist the final state unless the in-loop save already did
+        # (orbax raises StepAlreadyExists on a forced duplicate).
+        mngr.wait()
+        if mngr.latest_step() != config.max_steps - 1:
+            mngr.save(
+                config.max_steps - 1,
+                {"params": params, "opt_state": opt_state},
+                force=True,
+            )
+        mngr.close()
+    return {"params": params, "opt_state": opt_state, "metrics": metrics}

@@ -1,0 +1,4 @@
+from midgpt_tpu.utils.pytree import pytree_dataclass
+from midgpt_tpu.utils.precision import cast_floating
+
+__all__ = ["pytree_dataclass", "cast_floating"]

@@ -1,0 +1,25 @@
+"""Mixed-precision policy helpers.
+
+The training recipe (matching reference src/train.py:39-40,83: fp32 master
+params, per-step cast to bf16 compute, fp32 softmax and loss) is expressed by
+casting floating-point pytree leaves; integer leaves pass through untouched.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floating(tree: tp.Any, dtype: tp.Any) -> tp.Any:
+    """Cast every floating-point array leaf of `tree` to `dtype`."""
+    dtype = jnp.dtype(dtype)
+
+    def cast(x):
+        if isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)

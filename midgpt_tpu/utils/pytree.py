@@ -1,0 +1,48 @@
+"""Pytree dataclasses: the framework's minimal module system.
+
+Model/optimizer state are plain dataclasses of jax.Arrays registered as
+pytrees via `jax.tree_util.register_dataclass`. This keeps the framework
+dependency-light and plays perfectly with jit/scan/shard_map: params are just
+data, functions are just functions. (The reference reaches the same place via
+Equinox modules — reference src/model.py — but a module framework buys nothing
+on TPU where everything must be a traced pytree anyway.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+import jax
+
+_T = tp.TypeVar("_T")
+
+
+def pytree_dataclass(cls: tp.Optional[type] = None, *, meta_fields: tp.Sequence[str] = ()):
+    """Decorator: dataclass registered as a jax pytree.
+
+    Fields named in ``meta_fields`` are static (hashed into the treedef);
+    everything else is a child pytree.
+    """
+
+    def wrap(c: type) -> type:
+        c = dataclasses.dataclass(c)
+        fields = [f.name for f in dataclasses.fields(c)]
+        data_fields = tuple(f for f in fields if f not in meta_fields)
+        jax.tree_util.register_dataclass(c, data_fields, tuple(meta_fields))
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def tree_size(tree: tp.Any) -> int:
+    """Total number of array elements in a pytree."""
+    return sum(x.size for x in jax.tree.leaves(tree) if hasattr(x, "size"))
+
+
+def tree_bytes(tree: tp.Any) -> int:
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "size") and hasattr(x, "dtype")
+    )

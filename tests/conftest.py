@@ -1,0 +1,15 @@
+"""Test harness: 8 virtual CPU devices so mesh/FSDP/collective code paths run
+without TPUs (the test infra the reference lacks — SURVEY.md §4).
+
+Note: under the axon TPU plugin the JAX_PLATFORMS env var is overridden, so
+platform selection must go through the config API before first backend use.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+# This JAX build defaults matmuls to reduced (bf16-style) precision even on
+# CPU; force full f32 so numerical parity tests are meaningful.
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,108 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.ops.loss import cross_entropy_loss
+from midgpt_tpu.utils.precision import cast_floating
+
+CFG = GPTConfig(block_size=32, vocab_size=96, n_layer=2, n_head=2, n_embd=32, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT.init(CFG, jax.random.PRNGKey(0))
+
+
+def test_init_shapes(params):
+    D, C, L, V = CFG.n_embd, CFG.head_dim, CFG.n_layer, CFG.vocab_size
+    assert params.wte.shape == (V, D)
+    assert params.lm_head.shape == (V, D)
+    assert params.blocks.attn.wqkv.shape == (L, 3 * D, D)
+    assert params.blocks.attn.wo.shape == (L, D, D)
+    assert params.blocks.attn.q_scale.shape == (L, C)
+    assert params.blocks.mlp.w_up.shape == (L, 4 * D, D)
+    assert params.blocks.mlp.w_down.shape == (L, D, 4 * D)
+
+
+def test_init_weight_tying_init_only(params):
+    np.testing.assert_array_equal(np.asarray(params.wte), np.asarray(params.lm_head))
+    # but they are independent leaves:
+    leaves = jax.tree.leaves(params)
+    assert sum(1 for x in leaves if x.shape == (CFG.vocab_size, CFG.n_embd)) == 2
+
+
+def test_count_params(params):
+    D, C, L, V = CFG.n_embd, CFG.head_dim, CFG.n_layer, CFG.vocab_size
+    expected = V * D + L * (3 * D * D + D * D + 2 * C + 8 * D * D)
+    assert GPT.count_params(params) == expected
+
+
+def test_forward_shape_and_finite(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 16), 0, CFG.vocab_size)
+    logits = GPT.apply(CFG, params, tokens, inference=True)
+    assert logits.shape == (3, 16, CFG.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_forward_causal(params):
+    """Perturbing token t must not change logits before t."""
+    key = jax.random.PRNGKey(2)
+    tokens = jax.random.randint(key, (1, 16), 0, CFG.vocab_size)
+    logits1 = GPT.apply(CFG, params, tokens, inference=True)
+    tokens2 = tokens.at[0, 10].set((tokens[0, 10] + 1) % CFG.vocab_size)
+    logits2 = GPT.apply(CFG, params, tokens2, inference=True)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :10]), np.asarray(logits2[0, :10]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits1[0, 10:]), np.asarray(logits2[0, 10:]))
+
+
+def test_remat_matches_no_remat(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, CFG.vocab_size)
+    cfg_noremat = dataclasses.replace(CFG, remat=False)
+    l1 = GPT.apply(CFG, params, tokens, inference=True)
+    l2 = GPT.apply(cfg_noremat, params, tokens, inference=True)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+
+
+def test_attn_impl_parity(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 32), 0, CFG.vocab_size)
+    base = GPT.apply(CFG, params, tokens, inference=True)
+    cfg_blk = dataclasses.replace(CFG, attn_impl="blockwise", attn_block_size=16)
+    blk = GPT.apply(cfg_blk, params, tokens, inference=True)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(blk), atol=2e-5, rtol=2e-5)
+
+
+def test_grad_flows_everywhere(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, CFG.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(6), (2, 16), 0, CFG.vocab_size)
+
+    def loss(p):
+        return cross_entropy_loss(GPT.apply(CFG, p, tokens, inference=True), labels)
+
+    grads = jax.grad(loss)(params)
+    for path, g in jax.tree_util.tree_leaves_with_path(grads):
+        assert bool(jnp.isfinite(g).all()), path
+        assert float(jnp.abs(g).max()) > 0, f"zero grad at {jax.tree_util.keystr(path)}"
+
+
+def test_bf16_compute_close_to_fp32(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0, CFG.vocab_size)
+    labels = (tokens + 1) % CFG.vocab_size
+    l32 = cross_entropy_loss(GPT.apply(CFG, params, tokens, inference=True), labels)
+    pbf = cast_floating(params, jnp.bfloat16)
+    lbf = cross_entropy_loss(GPT.apply(CFG, pbf, tokens, inference=True), labels)
+    assert abs(float(l32) - float(lbf)) < 0.1
+
+
+def test_dropout_needs_key(params):
+    cfg = dataclasses.replace(CFG, dropout=0.1)
+    tokens = jnp.zeros((1, 8), dtype=jnp.int32)
+    with pytest.raises(ValueError):
+        GPT.apply(cfg, params, tokens, inference=False, key=None)
+    out = GPT.apply(cfg, params, tokens, inference=False, key=jax.random.PRNGKey(0))
+    assert bool(jnp.isfinite(out).all())

@@ -1,0 +1,97 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.ops import (
+    cross_entropy_loss,
+    dropout,
+    head_layer_norm,
+    multihead_attention,
+    rms_norm,
+)
+from midgpt_tpu.ops.attention import blockwise_causal_attention, naive_causal_attention
+
+
+def test_rms_norm_unit_scale():
+    x = jnp.full((4, 8), 3.0)
+    out = rms_norm(x)
+    np.testing.assert_allclose(np.asarray(out), np.ones((4, 8)), rtol=1e-5)
+
+
+def test_rms_norm_matches_formula():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (3, 16))
+    expected = x * (1.0 / np.sqrt(np.mean(np.square(np.asarray(x)), axis=-1, keepdims=True) + 1e-6))
+    np.testing.assert_allclose(np.asarray(rms_norm(x)), expected, rtol=1e-5)
+
+
+def test_rms_norm_weighted():
+    x = jnp.ones((2, 4))
+    w = jnp.arange(4.0)
+    out = rms_norm(x, weight=w)
+    np.testing.assert_allclose(np.asarray(out[0]), np.arange(4.0) * np.asarray(rms_norm(x))[0, 0], rtol=1e-5)
+
+
+def test_head_layer_norm_zero_mean_unit_var():
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (5, 32)) * 4 + 7
+    out = head_layer_norm(x, jnp.ones((32,)))
+    np.testing.assert_allclose(np.asarray(jnp.mean(out, -1)), np.zeros(5), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jnp.std(out, -1)), np.ones(5), atol=1e-2)
+
+
+def test_dropout_inference_identity():
+    x = jnp.ones((8, 8))
+    assert (dropout(x, 0.5, None, inference=True) == x).all()
+    assert (dropout(x, 0.0, None, inference=False) == x).all()
+
+
+def test_dropout_scales_kept_values():
+    key = jax.random.PRNGKey(2)
+    x = jnp.ones((1000,))
+    out = np.asarray(dropout(x, 0.25, key, inference=False))
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, np.full_like(kept, 1 / 0.75), rtol=1e-5)
+    assert 0.6 < (out != 0).mean() < 0.9
+
+
+def test_cross_entropy_uniform_logits():
+    logits = jnp.zeros((4, 7, 13))
+    labels = jnp.zeros((4, 7), dtype=jnp.int32)
+    np.testing.assert_allclose(float(cross_entropy_loss(logits, labels)), np.log(13), rtol=1e-5)
+
+
+def test_cross_entropy_peaked_logits():
+    labels = jnp.array([[2, 5]])
+    logits = jnp.full((1, 2, 8), -30.0)
+    logits = logits.at[0, 0, 2].set(30.0).at[0, 1, 5].set(30.0)
+    assert float(cross_entropy_loss(logits, labels)) < 1e-5
+
+
+@pytest.mark.parametrize("T,block", [(64, 16), (128, 128), (96, 32)])
+def test_blockwise_attention_matches_naive(T, block):
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    B, H, C = 2, 3, 16
+    q = jax.random.normal(kq, (B, H, T, C))
+    k = jax.random.normal(kk, (B, H, T, C))
+    v = jax.random.normal(kv, (B, H, T, C))
+    ref = naive_causal_attention(q, k, v)
+    out = blockwise_causal_attention(q, k, v, block_size=block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_attention_causality():
+    """Changing a future token must not change earlier outputs."""
+    key = jax.random.PRNGKey(4)
+    B, H, T, C = 1, 2, 32, 8
+    q, k, v = jax.random.split(key, 3)
+    q = jax.random.normal(q, (B, H, T, C))
+    k = jax.random.normal(k, (B, H, T, C))
+    v = jax.random.normal(v, (B, H, T, C))
+    out1 = multihead_attention(q, k, v, impl="naive", inference=True)
+    k2 = k.at[:, :, -1].add(100.0)
+    v2 = v.at[:, :, -1].add(100.0)
+    out2 = multihead_attention(q, k2, v2, impl="naive", inference=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :, :-1]), np.asarray(out2[:, :, :-1]), atol=1e-5)

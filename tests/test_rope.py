@@ -1,0 +1,62 @@
+"""RoPE properties. The shift-equivariance test promotes the reference's
+manual eyeball script (reference scripts/test_rotary.py:11-32) into a real
+assertion: rolling Q and K by s positions must roll the attention scores."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from midgpt_tpu.ops.rope import apply_rope, rope_table, rotate_interleaved
+
+
+def test_rotate_interleaved_pattern():
+    x = jnp.array([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(rotate_interleaved(x)), [[-2.0, 1.0, -4.0, 3.0]])
+
+
+def test_rope_preserves_norm():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (2, 4, 32, 16))
+    sin, cos = rope_table(16, 32)
+    out = apply_rope(x, sin, cos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rope_shift_equivariance():
+    """scores(rope(q), rope(k)) shifted == scores(rope(roll(q)), rope(roll(k)))."""
+    key = jax.random.PRNGKey(1)
+    kq, kk = jax.random.split(key)
+    H, T, C, s = 2, 64, 16, 5
+    q = jax.random.normal(kq, (H, T, C))
+    k = jax.random.normal(kk, (H, T, C))
+    sin, cos = rope_table(C, T)
+
+    def scores(q, k):
+        qr = apply_rope(q, sin, cos)
+        kr = apply_rope(k, sin, cos)
+        return jnp.einsum("hqc,hkc->hqk", qr, kr)
+
+    base = scores(q, k)
+    rolled = scores(jnp.roll(q, s, axis=1), jnp.roll(k, s, axis=1))
+    # Valid region: both query and key indices >= s after the roll.
+    np.testing.assert_allclose(
+        np.asarray(rolled[:, s:, s:]), np.asarray(base[:, :-s, :-s]), atol=1e-4
+    )
+
+
+def test_rope_positions_gather():
+    """Explicit positions must equal the contiguous-prefix default."""
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (1, 8, 16))
+    sin, cos = rope_table(16, 32)
+    out_default = apply_rope(x, sin, cos)
+    out_positions = apply_rope(x, sin, cos, positions=jnp.arange(8))
+    np.testing.assert_allclose(np.asarray(out_default), np.asarray(out_positions), atol=1e-6)
+    # A single token at absolute position p == slicing it out of a longer pass.
+    p = 5
+    single = apply_rope(x[:, p : p + 1], sin, cos, positions=jnp.array([p]))
+    np.testing.assert_allclose(np.asarray(single), np.asarray(out_default[:, p : p + 1]), atol=1e-6)
