@@ -95,6 +95,33 @@ class GPTParams:
     lm_head: Array  # (V, D), applied as x @ lm_head.T; init-tied to wte
 
 
+@pytree_dataclass
+class KVCache:
+    """Static-shape decode cache: (L, B, H, S, C) keys/values, filled up to
+    `length`. The reference has no KV cache at all — its generate loop runs a
+    full padded forward per token (reference sample.py:72-94); this is the
+    named upgrade in BASELINE.json."""
+
+    k: Array  # (n_layer, B, n_head, S, head_dim)
+    v: Array  # (n_layer, B, n_head, S, head_dim)
+    length: Array  # () int32: number of valid positions
+
+    @staticmethod
+    def init(config: "GPTConfig", batch_size: int, dtype=jnp.bfloat16) -> "KVCache":
+        shape = (
+            config.n_layer,
+            batch_size,
+            config.n_head,
+            config.block_size,
+            config.head_dim,
+        )
+        return KVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
 def _linear_init(key: KeyArray, out_features: int, in_features: int) -> Array:
     """Truncated-normal(±2σ) scaled 1/sqrt(fan_in) (reference layers.py:49-50)."""
     w = jax.random.truncated_normal(key, -2.0, 2.0, (out_features, in_features))
@@ -129,6 +156,45 @@ class GPT:
         return GPTParams(wte=embed, blocks=blocks, lm_head=embed)
 
     @staticmethod
+    def _project_qkv(
+        config: GPTConfig, block: BlockParams, h: Array
+    ) -> tp.Tuple[Array, Array, Array]:
+        """h (B, T, D) -> q, k, v (B, H, T, C) after QK-LayerNorm (no RoPE)."""
+        B, T, D = h.shape
+        H, C = config.n_head, config.head_dim
+        qkv = jnp.einsum("btd,ed->bte", h, block.attn.wqkv)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, C).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, H, C).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, H, C).transpose(0, 2, 1, 3)
+        q = head_layer_norm(q, block.attn.q_scale)
+        k = head_layer_norm(k, block.attn.k_scale)
+        return q, k, v
+
+    @staticmethod
+    def _attn_out_and_mlp(
+        config: GPTConfig,
+        block: BlockParams,
+        x: Array,  # (B, T, D) residual stream
+        att: Array,  # (B, H, T, C) attention output
+        *,
+        k_resid: tp.Optional[KeyArray] = None,
+        k_mlp: tp.Optional[KeyArray] = None,
+        inference: bool = True,
+    ) -> Array:
+        """Shared tail of a block: merge heads, output proj, MLP, residuals."""
+        B, H, T, C = att.shape
+        att = att.transpose(0, 2, 1, 3).reshape(B, T, config.n_embd)
+        att = jnp.einsum("btd,ed->bte", att, block.attn.wo)
+        att = dropout(att, config.dropout, k_resid, inference)
+        x = x + att
+        h = rms_norm(x)
+        h = jax.nn.gelu(jnp.einsum("btd,ed->bte", h, block.mlp.w_up))
+        h = jnp.einsum("bte,de->btd", h, block.mlp.w_down)
+        h = dropout(h, config.dropout, k_mlp, inference)
+        return x + h
+
+    @staticmethod
     def block_apply(
         config: GPTConfig,
         params: BlockParams,
@@ -139,25 +205,17 @@ class GPT:
         rope: tp.Optional[tp.Tuple[Array, Array]] = None,
         positions: tp.Optional[Array] = None,
     ) -> Array:
-        B, T, D = x.shape
-        H, C = config.n_head, config.head_dim
+        C = config.head_dim
         if rope is None:
-            rope = rope_table(C, T)
+            rope = rope_table(C, x.shape[1])
         sin, cos = rope
         if key is not None:
             k_attn_drop, k_resid, k_mlp = jax.random.split(key, 3)
         else:
             k_attn_drop = k_resid = k_mlp = None
 
-        # --- attention sublayer ---
         h = rms_norm(x)  # weightless, eps 1e-6
-        qkv = jnp.einsum("btd,ed->bte", h, params.attn.wqkv)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, C).transpose(0, 2, 1, 3)
-        k = k.reshape(B, T, H, C).transpose(0, 2, 1, 3)
-        v = v.reshape(B, T, H, C).transpose(0, 2, 1, 3)
-        q = head_layer_norm(q, params.attn.q_scale)
-        k = head_layer_norm(k, params.attn.k_scale)
+        q, k, v = GPT._project_qkv(config, params, h)
         q = apply_rope(q, sin, cos, positions)
         k = apply_rope(k, sin, cos, positions)
         att = multihead_attention(
@@ -170,17 +228,9 @@ class GPT:
             inference=inference,
             block_size=config.attn_block_size,
         )
-        att = att.transpose(0, 2, 1, 3).reshape(B, T, D)
-        att = jnp.einsum("btd,ed->bte", att, params.attn.wo)
-        att = dropout(att, config.dropout, k_resid, inference)
-        x = x + att
-
-        # --- MLP sublayer ---
-        h = rms_norm(x)
-        h = jax.nn.gelu(jnp.einsum("btd,ed->bte", h, params.mlp.w_up))
-        h = jnp.einsum("bte,de->btd", h, params.mlp.w_down)
-        h = dropout(h, config.dropout, k_mlp, inference)
-        return x + h
+        return GPT._attn_out_and_mlp(
+            config, params, x, att, k_resid=k_resid, k_mlp=k_mlp, inference=inference
+        )
 
     @staticmethod
     def apply(
@@ -222,6 +272,96 @@ class GPT:
 
         x = rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
         return jnp.einsum("btd,vd->btv", x, params.lm_head)
+
+    # ------------------------------------------------------------------
+    # KV-cached decoding. inference-only (no dropout keys).
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def prefill(
+        config: GPTConfig,
+        params: GPTParams,
+        tokens: Array,  # (B, T) with T <= block_size
+        cache: KVCache,
+    ) -> tp.Tuple[Array, KVCache]:
+        """Run the prompt through the model, filling cache positions [0, T).
+
+        Returns (logits (B, T, V), cache with length=T)."""
+        B, T = tokens.shape
+        S, C = config.block_size, config.head_dim
+        x = jnp.take(params.wte, tokens, axis=0)
+        sin, cos = rope_table(C, S)
+        rope = (sin[:T], cos[:T])
+
+        def block_fn(x, block: BlockParams):
+            h = rms_norm(x)
+            q, k, v = GPT._project_qkv(config, block, h)
+            qr = apply_rope(q, rope[0], rope[1])
+            kr = apply_rope(k, rope[0], rope[1])
+            att = multihead_attention(
+                qr, kr, v, impl=config.attn_impl, inference=True,
+                block_size=config.attn_block_size,
+            )
+            x = GPT._attn_out_and_mlp(config, block, x, att)
+            # cache stores post-norm, post-RoPE keys and raw values
+            return x, (kr, v)
+
+        x, (k_layers, v_layers) = jax.lax.scan(block_fn, x, params.blocks)
+        pad = [(0, 0), (0, 0), (0, 0), (0, S - T), (0, 0)]
+        new_cache = KVCache(
+            k=jnp.pad(k_layers.astype(cache.k.dtype), pad),
+            v=jnp.pad(v_layers.astype(cache.v.dtype), pad),
+            length=jnp.asarray(T, jnp.int32),
+        )
+        x = rms_norm(x, eps=1e-5)
+        logits = jnp.einsum("btd,vd->btv", x, params.lm_head)
+        return logits, new_cache
+
+    @staticmethod
+    def decode_step(
+        config: GPTConfig,
+        params: GPTParams,
+        token: Array,  # (B,) int — the newest token
+        cache: KVCache,
+    ) -> tp.Tuple[Array, KVCache]:
+        """One incremental decode step at position cache.length.
+
+        Precondition: cache.length < config.block_size. The cache is
+        static-shape; at a full cache the dynamic_update_slice would clamp to
+        the last slot and silently corrupt it, so callers (sampling engine)
+        must stop or fall back to windowed forward before that.
+
+        Returns (logits (B, V) for the next token, updated cache)."""
+        B = token.shape[0]
+        S, C = config.block_size, config.head_dim
+        pos = cache.length  # () int32
+        x = jnp.take(params.wte, token[:, None], axis=0)  # (B, 1, D)
+        sin, cos = rope_table(C, S)
+        positions = pos[None]  # (1,)
+
+        def block_fn(x, block_and_cache):
+            block, ck, cv = block_and_cache  # ck, cv: (B, H, S, C)
+            h = rms_norm(x)
+            q, k, v = GPT._project_qkv(config, block, h)  # (B, H, 1, C)
+            q = apply_rope(q, sin, cos, positions)
+            k = apply_rope(k, sin, cos, positions)
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+            scores = jnp.einsum("bhqc,bhkc->bhqk", q, ck)  # (B, H, 1, S)
+            valid = jnp.arange(S)[None, None, None, :] <= pos
+            scores = jnp.where(valid, scores, float("-inf"))
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+            ).astype(q.dtype)
+            att = jnp.einsum("bhqk,bhkc->bhqc", probs, cv)
+            x = GPT._attn_out_and_mlp(config, block, x, att)
+            return x, (ck, cv)
+
+        x, (k_new, v_new) = jax.lax.scan(block_fn, x, (params.blocks, cache.k, cache.v))
+        x = rms_norm(x, eps=1e-5)
+        logits = jnp.einsum("btd,vd->btv", x, params.lm_head)[:, 0]
+        new_cache = KVCache(k=k_new, v=v_new, length=pos + 1)
+        return logits, new_cache
 
     @staticmethod
     def count_params(params: GPTParams) -> int:

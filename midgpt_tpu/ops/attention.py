@@ -62,8 +62,14 @@ def blockwise_causal_attention(
     """
     B, H, T, C = q.shape
     blk = min(block_size, T)
+    T_orig = T
     if T % blk != 0:
-        raise ValueError(f"seq len {T} must be divisible by block size {blk}")
+        # Pad to a block multiple (arbitrary-length prompts in prefill). The
+        # causal mask zeroes padded keys for real queries; padded query rows
+        # are sliced off below.
+        pad = blk - T % blk
+        q, k, v = (jnp.pad(a, ((0, 0), (0, 0), (0, pad), (0, 0))) for a in (q, k, v))
+        T = T + pad
     n_blk = T // blk
     scale = 1.0 / math.sqrt(C)
 
@@ -102,10 +108,12 @@ def blockwise_causal_attention(
             jnp.zeros((B, H, blk), jnp.float32),
         )
         (acc, _, denom), _ = jax.lax.scan(kv_step, init, jnp.arange(n_blk))
-        return (acc / denom[..., None]).astype(q.dtype)
+        # max() guards fully-masked (padded) query rows against 0/0 NaN.
+        return (acc / jnp.maximum(denom, 1e-30)[..., None]).astype(q.dtype)
 
     outs = [q_block_fn(qi, qb[:, :, qi]) for qi in range(n_blk)]
-    return jnp.stack(outs, axis=2).reshape(B, H, T, C)
+    out = jnp.stack(outs, axis=2).reshape(B, H, T, C)
+    return out[:, :, :T_orig]
 
 
 def multihead_attention(

@@ -1,0 +1,3 @@
+from midgpt_tpu.sampling.engine import generate
+
+__all__ = ["generate"]

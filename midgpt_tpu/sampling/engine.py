@@ -1,0 +1,118 @@
+"""Autoregressive sampling with a static KV cache.
+
+The reference's generate loop re-runs a full right-padded forward over the
+whole block for EVERY new token (reference sample.py:68-95) — O(T) full
+forwards. Here: one jitted prefill over the prompt, then one jitted
+single-token decode step per new token against the (n_layer, B, H, S, C)
+cache, with the cache buffers donated so XLA updates them in place. Both
+functions have static shapes, so the loop compiles exactly twice.
+
+If generation would run past `block_size`, decoding falls back to the
+reference's windowed full-forward scheme for the overflow tokens (the cache
+is sized to the trained context; RoPE positions past it are extrapolation).
+"""
+
+from __future__ import annotations
+
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, KVCache
+
+Array = jax.Array
+
+
+def sample_logits(
+    logits: Array,  # (B, V) float
+    key: Array,
+    temperature: float = 1.0,
+    top_k: tp.Optional[int] = None,
+) -> Array:
+    """Temperature + optional top-k sampling; temperature 0 = greedy."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+def _prefill_and_first(config, params, tokens, key, temperature, top_k):
+    logits, cache = GPT.prefill(config, params, tokens, KVCache.init(
+        config, tokens.shape[0], dtype=tokens_dtype(params)))
+    first = sample_logits(logits[:, -1], key, temperature, top_k)
+    return first, cache
+
+
+def tokens_dtype(params: GPTParams):
+    return params.wte.dtype
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(3,))
+def _decode_and_sample(config, params, token, cache, temperature, top_k, key):
+    logits, cache = GPT.decode_step(config, params, token, cache)
+    nxt = sample_logits(logits, key, temperature, top_k)
+    return nxt, cache
+
+
+def generate(
+    config: GPTConfig,
+    params: GPTParams,
+    prompt: Array,  # (B, T0) int32
+    max_new_tokens: int,
+    *,
+    temperature: float = 1.0,
+    top_k: tp.Optional[int] = None,
+    key: tp.Optional[Array] = None,
+) -> Array:
+    """Returns (B, T0 + max_new_tokens) including the prompt."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    B, T0 = prompt.shape
+    S = config.block_size
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if T0 > S:
+        prompt_ctx = prompt[:, -S:]
+    else:
+        prompt_ctx = prompt
+
+    out = [prompt]
+    key, k0 = jax.random.split(key)
+    nxt, cache = _prefill_and_first(
+        config, params, prompt_ctx, k0, temperature, top_k
+    )
+    out.append(nxt[:, None])
+    produced = 1
+
+    # Fast path: incremental decode while the write position fits the cache.
+    # Decode call #i writes K/V at position T_ctx + i, and at loop entry the
+    # next call index is (produced - 1), so the last usable iteration has
+    # T_ctx + produced - 1 == S - 1.
+    T_ctx = int(min(T0, S))
+    while produced < max_new_tokens and T_ctx + produced <= S:
+        key, k = jax.random.split(key)
+        nxt, cache = _decode_and_sample(
+            config, params, nxt, cache, temperature, top_k, k
+        )
+        out.append(nxt[:, None])
+        produced += 1
+
+    # Overflow: windowed full-forward per token (reference scheme).
+    if produced < max_new_tokens:
+        seq = jnp.concatenate(out, axis=1)
+        forward = jax.jit(
+            lambda p, t: GPT.apply(config, p, t, inference=True)[:, -1]
+        )
+        for _ in range(max_new_tokens - produced):
+            key, k = jax.random.split(key)
+            window = seq[:, -S:]
+            nxt = sample_logits(forward(params, window), k, temperature, top_k)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        return seq
+
+    return jnp.concatenate(out, axis=1)

@@ -14,6 +14,10 @@ optimizer chain just to get a skeleton):
     a final barrier on close (reference train.py:224-225).
 
 Works on local paths and gs:// rundirs alike (TensorStore handles both).
+
+Layout note: checkpoints are saved as named Composite items ("params",
+"opt_state"); this is the framework's only supported layout — there is no
+reader for other orbax layouts.
 """
 
 from __future__ import annotations
@@ -28,6 +32,14 @@ def _abstract_like(tree: tp.Any) -> tp.Any:
     def conv(x):
         if isinstance(x, jax.Array):
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.ShapeDtypeStruct) and x.sharding is None:
+            # Orbax needs a concrete sharding to deserialize into; default to
+            # replicated-on-default-device (the sampler's single-chip case).
+            return jax.ShapeDtypeStruct(
+                x.shape,
+                x.dtype,
+                sharding=jax.sharding.SingleDeviceSharding(jax.devices()[0]),
+            )
         return x
 
     return jax.tree.map(conv, tree)
@@ -55,15 +67,28 @@ class CheckpointManager:
     def latest_step(self) -> tp.Optional[int]:
         return self._mngr.latest_step()
 
-    def save(self, step: int, state: tp.Any, *, force: bool = False) -> bool:
-        """Queue an async save; the manager filters by save_interval_steps
-        unless `force` (used for the final step of a run)."""
-        return self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+    def save(self, step: int, state: tp.Dict[str, tp.Any], *, force: bool = False) -> bool:
+        """Queue an async save of named items (e.g. {"params": ..., "opt_state": ...});
+        the manager filters by save_interval_steps unless `force` (used for the
+        final step of a run)."""
+        args = ocp.args.Composite(
+            **{name: ocp.args.StandardSave(item) for name, item in state.items()}
+        )
+        return self._mngr.save(step, args=args, force=force)
 
-    def restore(self, step: int, like: tp.Any) -> tp.Any:
-        """Restore into the structure/shardings of `like` (live or abstract)."""
-        abstract = _abstract_like(like)
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+    def restore(self, step: int, like: tp.Dict[str, tp.Any]) -> tp.Dict[str, tp.Any]:
+        """Restore named items into the structure/shardings of `like` (live or
+        abstract trees). Restoring a SUBSET of the saved items is supported —
+        the sampler restores only {"params": ...} without touching the
+        optimizer state."""
+        args = ocp.args.Composite(
+            **{
+                name: ocp.args.StandardRestore(_abstract_like(item))
+                for name, item in like.items()
+            }
+        )
+        restored = self._mngr.restore(step, args=args)
+        return {name: restored[name] for name in like}
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()

@@ -1,0 +1,108 @@
+"""Sample from a trained checkpoint (reference sample.py's surface, KV-cached).
+
+    python sample.py --ckpt_dir=outputs/<run> [--start="\\n"|FILE:prompt.txt]
+        [--num_samples=10] [--max_new_tokens=500] [--temperature=0.8] [--top_k=K]
+
+Differences from the reference: decoding uses a static KV cache (one full
+forward for the prompt, one single-token step per new token) instead of a
+full padded forward per token (reference sample.py:68-95); and only the
+model params item is restored from the checkpoint — no optimizer skeleton
+reconstruction (reference sample.py:111-137) thanks to the named-item layout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ckpt_dir", type=str, required=True)
+    parser.add_argument("--start", type=str, default="\n")
+    parser.add_argument("--num_samples", type=int, default=10)
+    parser.add_argument("--max_new_tokens", type=int, default=500)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--top_k", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    import jax
+
+    if os.environ.get("MIDGPT_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["MIDGPT_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from midgpt_tpu.config import from_json
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.sampling.engine import generate
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+    from midgpt_tpu.utils.precision import cast_floating
+
+    config_path = os.path.join(args.ckpt_dir, "config.json")
+    if args.ckpt_dir.startswith("gs://"):
+        import gcsfs
+
+        with gcsfs.GCSFileSystem().open(config_path, "r") as f:
+            config = from_json(f.read())
+    else:
+        with open(config_path, "r") as f:
+            config = from_json(f.read())
+    model_cfg = config.model_config
+    print(config)
+
+    # Abstract params skeleton -> restore just the "params" item.
+    abstract = jax.eval_shape(lambda k: GPT.init(model_cfg, k), jax.random.PRNGKey(0))
+    abstract = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.dtype(config.param_dtype)), abstract
+    )
+    mngr = CheckpointManager(args.ckpt_dir)
+    step = mngr.latest_step()
+    if step is None:
+        raise SystemExit(f"no checkpoint found under {args.ckpt_dir}")
+    params = mngr.restore(step, {"params": abstract})["params"]
+    print(f"restored checkpoint step {step}")
+    params = cast_floating(params, jnp.dtype(config.compute_dtype))
+
+    # Tokenizer: char codec if the dataset ships one, else GPT-2 BPE
+    # (reference sample.py:143-159).
+    meta_path = os.path.join(config.data_dir, "meta.pkl")
+    if os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        stoi, itos = meta["stoi"], meta["itos"]
+        encode = lambda s: [stoi[c] for c in s]
+        decode = lambda ids: "".join(itos[i] for i in ids)
+    else:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+        encode = lambda s: enc.encode(s, allowed_special={"<|endoftext|>"})
+        decode = enc.decode
+
+    start = args.start
+    if start.startswith("FILE:"):
+        with open(start[5:], "r", encoding="utf-8") as f:
+            start = f.read()
+    start_ids = encode(start if start != "" else "\n")
+    prompt = np.tile(np.asarray(start_ids, np.int32), (args.num_samples, 1))
+
+    out = generate(
+        model_cfg,
+        params,
+        prompt,
+        args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        key=jax.random.PRNGKey(args.seed),
+    )
+    for i in range(args.num_samples):
+        print(decode(np.asarray(out[i]).tolist()))
+        print("---------------")
+
+
+if __name__ == "__main__":
+    main()
