@@ -27,7 +27,7 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--batch", type=int, default=32)
     parser.add_argument("--attn", type=str, default=None, choices=[None, "naive", "flash", "blockwise"])
     args = parser.parse_args()
 
@@ -41,7 +41,9 @@ def main() -> int:
 
     n_dev = jax.device_count()
     model_cfg = base_config.model_config
-    attn = args.attn or "naive"  # TODO: default to 'flash' once the Pallas kernel lands
+    # Pallas flash kernel on TPU; naive elsewhere (interpret mode is too slow
+    # for a benchmark).
+    attn = args.attn or ("flash" if jax.default_backend() == "tpu" else "naive")
     import dataclasses
 
     model_cfg = dataclasses.replace(model_cfg, attn_impl=attn)

@@ -1,6 +1,6 @@
 """7B Llama-shape, seq 4096, 2D data x fsdp mesh + grad accum (BASELINE.json
-configs list). Long context uses the blockwise O(T) attention path (the Pallas
-flash kernel and ring-attention context parallelism take over as they land)."""
+configs list). Long context rides the Pallas flash-attention kernel
+(ring-attention context parallelism over mesh.sp takes over when it lands)."""
 
 from midgpt_tpu.config import ExperimentConfig, MeshConfig
 from midgpt_tpu.models.gpt import GPTConfig
@@ -29,6 +29,6 @@ config = ExperimentConfig(
         n_head=32,
         n_embd=4096,
         dropout=0.0,
-        attn_impl="blockwise",
+        attn_impl="flash",
     ),
 )

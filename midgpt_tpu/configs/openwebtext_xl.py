@@ -31,5 +31,6 @@ config = ExperimentConfig(
         n_head=16,
         n_embd=2048,
         dropout=0.0,
+        attn_impl="flash",
     ),
 )

@@ -1,0 +1,319 @@
+"""Pallas TPU flash attention (causal, FlashAttention-2 style) with custom VJP.
+
+Replaces the reference's materialized T×T attention (reference model.py:71-77)
+— the O(T²) memory wall that caps its context at 1024 — with tiled
+online-softmax kernels:
+
+  * forward: grid (B*H, n_q, n_k), KV innermost. TPU grid steps execute
+    sequentially over the minor dimension, so the (m, l, acc) running
+    statistics live in VMEM scratch across the KV sweep of each Q tile.
+    Blocks strictly above the causal diagonal are predicated off with
+    pl.when; the diagonal block is masked elementwise.
+  * backward: two kernels — dQ (grid over KV for each Q tile) and dK/dV
+    (grid over Q for each KV tile) — recomputing p = exp(s - lse) from the
+    saved log-sum-exp rather than storing T×T probabilities.
+
+Numerics match the reference semantics: QK^T and PV matmuls run on the MXU
+in the input dtype (bf16) with float32 accumulation (preferred_element_type),
+the softmax/statistics are float32, and the 1/sqrt(C) scale is applied to the
+f32 scores exactly as reference model.py:76 does.
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests);
+numerical parity against the naive path is asserted in tests/test_flash.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+NEG_INF = float("-inf")
+# lane width of the statistics scratch (TPU vector registers are (8, 128))
+_STATS_LANES = 128
+
+# Run the kernels in interpret mode off-TPU (tests set this; the normal
+# dispatcher in ops/attention.py falls back to blockwise instead, because
+# interpret mode is orders of magnitude slower than compiled jnp).
+RUN_INTERPRET_OFF_TPU = False
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(T: int, block_q: int, block_k: int) -> tp.Tuple[int, int]:
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    if T % bq or T % bk:
+        raise ValueError(f"seq len {T} must be a multiple of block sizes ({bq}, {bk})")
+    return bq, bk
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # causal: KV block strictly above the diagonal contributes nothing
+    @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+    def _compute():
+        q = q_ref[0]  # (block_q, C)
+        k = k_ref[0]  # (block_k, C)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k) f32
+
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(row >= col, s, NEG_INF)
+
+        m_prev = m_sc[:, 0]  # (block_q,)
+        l_prev = l_sc[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.exp(s - m_new[:, None])  # rows with all -inf give exp(-inf)=0
+        p = jnp.where(s == NEG_INF, 0.0, p)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_sc[:] = acc_sc[:] * alpha[:, None] + pv
+        m_sc[:] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        l = l_sc[:, 0]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_sc[:] / safe_l[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_sc[:, 0] + jnp.log(safe_l), NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _flash_forward(
+    q: Array, k: Array, v: Array, block_q: int, block_k: int
+) -> tp.Tuple[Array, Array]:
+    B, H, T, C = q.shape
+    bq, bk = _block_sizes(T, block_q, block_k)
+    scale = 1.0 / math.sqrt(C)
+    qf = q.reshape(B * H, T, C)
+    kf = k.reshape(B * H, T, C)
+    vf = v.reshape(B * H, T, C)
+    grid = (B * H, T // bq, T // bk)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, bq, _STATS_LANES), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, C), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, _STATS_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, C), jnp.float32),
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, C), lse[:, :, 0].reshape(B, H, T)
+
+
+# ----------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale, block_q, block_k
+):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        masked = row >= col
+        lse = lse_ref[0][:, 0]  # (block_q,)
+        p = jnp.where(masked, jnp.exp(s - lse[:, None]), 0.0)
+        do = do_ref[0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        delta = delta_ref[0][:, 0]  # (block_q,)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_sc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc,
+    *, scale, block_q, block_k,
+):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    # causal: only Q blocks at/below the diagonal see this KV block
+    @pl.when(iq * block_q + (block_q - 1) >= ik * block_k)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        masked = row >= col
+        lse = lse_ref[0][:, 0]
+        p = jnp.where(masked, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
+        do = do_ref[0]
+        dv_sc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, C)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        delta = delta_ref[0][:, 0]
+        ds = p * (dp - delta[:, None]) * scale  # (bq, bk)
+        dk_sc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, C)
+
+    @pl.when(iq == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(block_q, block_k, residuals, g):
+    q, k, v, out, lse = residuals
+    B, H, T, C = q.shape
+    bq, bk = _block_sizes(T, block_q, block_k)
+    scale = 1.0 / math.sqrt(C)
+
+    # delta_i = rowsum(dO * O): the softmax-jacobian correction term.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # (B,H,T)
+
+    qf, kf, vf = (a.reshape(B * H, T, C) for a in (q, k, v))
+    dof = g.reshape(B * H, T, C)
+    lsef = jnp.broadcast_to(lse.reshape(B * H, T, 1), (B * H, T, _STATS_LANES))
+    deltaf = jnp.broadcast_to(delta.reshape(B * H, T, 1), (B * H, T, _STATS_LANES))
+
+    q_spec = pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM)
+    stat_q_spec = pl.BlockSpec(
+        (1, bq, _STATS_LANES), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk),
+        grid=(B * H, T // bq, T // bk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, stat_q_spec, stat_q_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, C), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, C), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)[0]
+
+    # dk/dv: KV tile is the outer loop, Q sweep is innermost.
+    q_spec2 = pl.BlockSpec((1, bq, C), lambda b, ik, iq: (b, iq, 0), memory_space=pltpu.VMEM)
+    k_spec2 = pl.BlockSpec((1, bk, C), lambda b, ik, iq: (b, ik, 0), memory_space=pltpu.VMEM)
+    stat_q_spec2 = pl.BlockSpec(
+        (1, bq, _STATS_LANES), lambda b, ik, iq: (b, iq, 0), memory_space=pltpu.VMEM
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq, block_k=bk),
+        grid=(B * H, T // bk, T // bq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, stat_q_spec2, stat_q_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, C), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, C), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, C), jnp.float32),
+            pltpu.VMEM((bk, C), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (
+        dq.reshape(B, H, T, C),
+        dk.reshape(B, H, T, C),
+        dv.reshape(B, H, T, C),
+    )
+
+
+# ----------------------------------------------------------------------
+# public op
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(
+    q: Array, k: Array, v: Array, block_q: int = 256, block_k: int = 256
+) -> Array:
+    """Causal flash attention over (B, H, T, C); T must divide the blocks."""
+    out, _ = _flash_forward(q, k, v, block_q, block_k)
+    return out
+
+
+def _fwd_rule(q, k, v, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+flash_attention.defvjp(_fwd_rule, _flash_backward)

@@ -144,7 +144,18 @@ def multihead_attention(
     if impl == "blockwise":
         return blockwise_causal_attention(q, k, v, block_size=block_size)
     if impl == "flash":
-        from midgpt_tpu.kernels.flash_attention import flash_attention
+        import importlib
 
-        return flash_attention(q, k, v)
+        # the real module (the package re-exports a same-named function)
+        fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
+
+        T = q.shape[-2]
+        blk = min(block_size, T)
+        tpu_ok = jax.default_backend() == "tpu" or fa.RUN_INTERPRET_OFF_TPU
+        if T % blk != 0 or not tpu_ok:
+            # Arbitrary prompt lengths (KV-cache prefill) and non-TPU
+            # backends take the equivalent blockwise path — same online
+            # softmax, plain jnp.
+            return blockwise_causal_attention(q, k, v, block_size=block_size)
+        return fa.flash_attention(q, k, v, blk, blk)
     raise ValueError(f"unknown attention impl {impl!r}")
