@@ -1,0 +1,111 @@
+"""Offline prep for openwebtext: HF dataset → GPT-2 BPE → uint16 memmap streams.
+
+Produces `train.bin` (~9B tokens, ~17GB) and `val.bin` in the flat uint16
+format `midgpt_tpu.data.TokenDataset` samples from. Capability parity with
+reference data/openwebtext/prepare.py:21-76 (load_dataset → 0.05% val split
+→ tiktoken encode + end-of-text sentinel per document → parallel map →
+memmap concat), redesigned around a chunked stream writer: token counts are
+precomputed per split, each split is written through a bounded-size buffer
+(constant RAM regardless of dataset size), and both deps are import-gated
+with actionable errors for air-gapped hosts.
+
+Tokenization is identical to the reference recipe so checkpoints/losses are
+comparable: `encode_ordinary` (no special-token splitting) with the GPT-2
+end-of-text id appended to every document. Run on a beefy CPU host, not the
+TPU VM, if you can — this is pure preprocessing.
+
+Usage:
+    python data/openwebtext/prepare.py [--num-proc N] [--out-dir DIR]
+    python data/openwebtext/prepare.py --dataset stas/openwebtext-10k  # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+try:
+    from datasets import load_dataset
+except ImportError:
+    sys.exit("pip install datasets  (HF hub access required; run on a host with egress)")
+try:
+    import tiktoken
+except ImportError:
+    sys.exit("pip install tiktoken")
+
+VAL_FRACTION = 0.0005
+SPLIT_SEED = 2357  # same split seed as the reference recipe → same val set
+
+
+def tokenize_split(dataset, num_proc: int):
+    enc = tiktoken.get_encoding("gpt2")
+
+    def encode_doc(example):
+        ids = enc.encode_ordinary(example["text"])
+        ids.append(enc.eot_token)
+        return {"ids": ids, "n": len(ids)}
+
+    return dataset.map(
+        encode_doc,
+        remove_columns=["text"],
+        desc="tokenizing",
+        num_proc=num_proc,
+    )
+
+
+def write_split(tokenized, path: str, buffer_tokens: int = 16 * 1024 * 1024) -> int:
+    """Stream `ids` lists into a uint16 memmap through a bounded buffer.
+
+    Iterates the dataset in batches (never materializing the full `ids`
+    column — at openwebtext scale that would be hundreds of GB of Python
+    lists) and flushes through a fixed-size staging buffer."""
+    total = int(np.sum(tokenized["n"], dtype=np.uint64))
+    out = np.memmap(path, dtype=np.uint16, mode="w+", shape=(total,))
+    buf = np.empty(buffer_tokens, dtype=np.uint16)
+    fill = 0
+    cursor = 0
+    for batch in tokenized.select_columns(["ids"]).iter(batch_size=1024):
+        for ids in batch["ids"]:
+            n = len(ids)
+            if fill + n > buffer_tokens:
+                out[cursor : cursor + fill] = buf[:fill]
+                cursor += fill
+                fill = 0
+            if n > buffer_tokens:  # pathological mega-document: bypass buffer
+                out[cursor : cursor + n] = np.asarray(ids, dtype=np.uint16)
+                cursor += n
+                continue
+            buf[fill : fill + n] = np.asarray(ids, dtype=np.uint16)
+            fill += n
+    out[cursor : cursor + fill] = buf[:fill]
+    cursor += fill
+    assert cursor == total, f"wrote {cursor} of {total} tokens"
+    out.flush()
+    return total
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dataset", type=str, default="openwebtext",
+                        help="HF dataset name (use a small one to smoke-test)")
+    parser.add_argument("--num-proc", type=int, default=max(1, (os.cpu_count() or 2) // 2))
+    parser.add_argument("--out-dir", type=str, default=os.path.dirname(os.path.abspath(__file__)))
+    args = parser.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    raw = load_dataset(args.dataset, split="train", num_proc=args.num_proc)
+    parts = raw.train_test_split(test_size=VAL_FRACTION, seed=SPLIT_SEED, shuffle=True)
+    splits = {"train": parts["train"], "val": parts["test"]}
+
+    for name, ds in splits.items():
+        tokenized = tokenize_split(ds, args.num_proc)
+        path = os.path.join(args.out_dir, f"{name}.bin")
+        total = write_split(tokenized, path)
+        print(f"{name}: {total:,} tokens -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Offline prep for the char-level tiny-shakespeare dataset.
+
+Produces the token-stream format `midgpt_tpu.data.TokenDataset` reads:
+`train.bin` / `val.bin` flat uint16 streams plus `meta.pkl` holding the char
+codec (vocab_size, stoi, itos) that `sample.py` uses to encode prompts and
+decode samples.
+
+Capability parity with reference data/shakespeare_char/prepare.py:12-61
+(download → char vocab → 90/10 split → uint16 bins + meta.pkl), redesigned
+for this repo: stdlib-only download with an explicit offline story (pass
+--input to use any local text file — air-gapped TPU pods rarely have
+egress), deterministic output, and a printed token count per split.
+
+Usage:
+    python data/shakespeare_char/prepare.py               # download + build
+    python data/shakespeare_char/prepare.py --input my.txt  # offline
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import urllib.request
+
+import numpy as np
+
+URL = (
+    "https://raw.githubusercontent.com/karpathy/char-rnn/master/"
+    "data/tinyshakespeare/input.txt"
+)
+
+
+def fetch_text(out_dir: str, input_path: str | None) -> str:
+    if input_path:
+        with open(input_path, "r", encoding="utf-8") as f:
+            return f.read()
+    cached = os.path.join(out_dir, "input.txt")
+    if os.path.exists(cached):
+        with open(cached, "r", encoding="utf-8") as f:
+            return f.read()
+    try:
+        with urllib.request.urlopen(URL, timeout=30) as r:
+            text = r.read().decode("utf-8")
+    except OSError as e:
+        sys.exit(
+            f"download failed ({e}); no network? Pass --input <file.txt> "
+            f"or place input.txt next to this script."
+        )
+    with open(cached, "w", encoding="utf-8") as f:
+        f.write(text)
+    return text
+
+
+def build(text: str, out_dir: str, val_fraction: float = 0.1) -> None:
+    chars = sorted(set(text))
+    stoi = {ch: i for i, ch in enumerate(chars)}
+    itos = {i: ch for i, ch in enumerate(chars)}
+    ids = np.frombuffer(
+        np.array([stoi[c] for c in text], dtype=np.uint16).tobytes(), dtype=np.uint16
+    )
+
+    n_val = int(len(ids) * val_fraction)
+    splits = {"train": ids[: len(ids) - n_val], "val": ids[len(ids) - n_val :]}
+    for name, arr in splits.items():
+        path = os.path.join(out_dir, f"{name}.bin")
+        arr.tofile(path)
+        print(f"{name}: {len(arr):,} tokens -> {path}")
+
+    meta = {"vocab_size": len(chars), "stoi": stoi, "itos": itos}
+    with open(os.path.join(out_dir, "meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    print(f"vocab: {len(chars)} chars -> meta.pkl")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", type=str, default=None, help="local text file (skip download)")
+    parser.add_argument("--out-dir", type=str, default=os.path.dirname(os.path.abspath(__file__)))
+    parser.add_argument("--val-fraction", type=float, default=0.1)
+    args = parser.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    build(fetch_text(args.out_dir, args.input), args.out_dir, args.val_fraction)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,7 +57,26 @@ class ExperimentConfig:
     seed: int = 0
     data_seed: int = 1337  # seeded, resumable data sampler (reference has none)
     fsdp_min_size: int = 2**18  # shard only params bigger than this (reference model.py:171)
+    # Token-chunk size of the fused lm_head+CE loss (ops/loss.py): bounds the
+    # f32 logits buffer to chunk×V instead of B·T×V.
+    loss_chunk_tokens: int = 8192
+    # FSDP collective authoring: 'gspmd' = sharding constraints, compiler
+    # chooses collectives (reference parity); 'shard_map' = explicit per-layer
+    # all-gather / grad reduce-scatter (parallel/shard_map_fsdp.py).
+    fsdp_mode: str = "gspmd"
     debug: bool = False
+
+    def __post_init__(self):
+        # Fail at construction, not at trace time deep inside the first step:
+        # attention-probability dropout exists only on the naive path
+        # (ops/attention.py dispatch).
+        mc = self.model_config
+        if mc.dropout > 0.0 and mc.attn_impl != "naive":
+            raise ValueError(
+                f"attn_impl={mc.attn_impl!r} does not support attention "
+                f"dropout (dropout={mc.dropout}); use attn_impl='naive' or "
+                "set dropout=0.0"
+            )
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

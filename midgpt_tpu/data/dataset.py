@@ -13,10 +13,6 @@ Two deliberate upgrades over the reference:
   * **Optional RAM copy.** The reference always copies the full 17GB stream
     into host RAM (train.py:132-133). `in_ram=False` keeps the memmap and
     lets the page cache do its job.
-
-When the native batcher extension is built (midgpt_tpu/runtime), the gather
-loop runs in threaded C++ with prefetch; this module is the always-available
-fallback with identical output.
 """
 
 from __future__ import annotations
@@ -78,7 +74,9 @@ class TokenDataset:
                 import jax
 
                 n_proc, idx = jax.process_count(), jax.process_index()
-                per = len(arr) // n_proc + 1
+                # Equal-length contiguous slices (remainder tokens dropped) so
+                # every process samples from the same-sized pool.
+                per = len(arr) // n_proc
                 arr = arr[idx * per : (idx + 1) * per]
             if in_ram:
                 arr = np.ascontiguousarray(arr)

@@ -39,6 +39,13 @@ NEG_INF = float("-inf")
 # lane width of the statistics scratch (TPU vector registers are (8, 128))
 _STATS_LANES = 128
 
+# Grid semantics: batch*heads and Q tiles are independent ("parallel");
+# the KV sweep is the sequential reduction dimension ("arbitrary"). Lets
+# Mosaic pipeline/parallelize grid steps instead of running them serially.
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+
 # Run the kernels in interpret mode off-TPU (tests set this; the normal
 # dispatcher in ops/attention.py falls back to blockwise instead, because
 # interpret mode is orders of magnitude slower than compiled jnp).
@@ -144,6 +151,7 @@ def _flash_forward(
             pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
             pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
     )(qf, kf, vf)
     return out.reshape(B, H, T, C), lse[:, :, 0].reshape(B, H, T)
@@ -265,6 +273,7 @@ def _flash_backward(block_q, block_k, residuals, g):
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((B * H, T, C), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, C), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, deltaf)[0]
 
@@ -287,6 +296,7 @@ def _flash_backward(block_q, block_k, residuals, g):
             pltpu.VMEM((bk, C), jnp.float32),
             pltpu.VMEM((bk, C), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, deltaf)
 

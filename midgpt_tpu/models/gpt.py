@@ -34,6 +34,7 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from midgpt_tpu.ops.attention import multihead_attention
 from midgpt_tpu.ops.dropout import dropout
@@ -59,6 +60,13 @@ class GPTConfig:
     attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash'
     attn_block_size: int = 512  # tile size for blockwise/flash paths
     remat: bool = True  # checkpoint each block inside the layer scan
+    # What the per-block checkpoint may keep instead of recomputing in bwd:
+    #   'none'  — save nothing (full recompute; minimum memory)
+    #   'dots'  — save outputs of matmuls with no batch dims (the QKV/out/MLP
+    #             projections; attention internals still recompute — they're
+    #             cheap under flash and their T×T buffers are what remat is
+    #             protecting against)
+    remat_policy: str = "dots"
     scan_unroll: int = 1  # unroll factor of the layer scan
 
     @property
@@ -120,6 +128,24 @@ class KVCache:
             v=jnp.zeros(shape, dtype),
             length=jnp.zeros((), jnp.int32),
         )
+
+
+def _remat_policy(name: str):
+    if name == "none":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "dots_attn":
+        # Projections AND the attention output: backward never re-runs the
+        # flash forward kernel (attention is >half the block FLOPs at T=1024;
+        # its own bwd already recomputes p from the saved lse).
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    raise ValueError(
+        f"unknown remat_policy {name!r} (expected 'none', 'dots' or 'dots_attn')"
+    )
 
 
 def _linear_init(key: KeyArray, out_features: int, in_features: int) -> Array:
@@ -228,20 +254,33 @@ class GPT:
             inference=inference,
             block_size=config.attn_block_size,
         )
+        att = checkpoint_name(att, "attn_out")
         return GPT._attn_out_and_mlp(
             config, params, x, att, k_resid=k_resid, k_mlp=k_mlp, inference=inference
         )
 
     @staticmethod
-    def apply(
+    def hidden(
         config: GPTConfig,
         params: GPTParams,
         tokens: Array,  # (B, T) int
         *,
         key: tp.Optional[KeyArray] = None,
         inference: bool = False,
+        layer_transform: tp.Optional[tp.Callable[[BlockParams], BlockParams]] = None,
     ) -> Array:
-        """Forward pass -> logits (B, T, V) in the params' floating dtype."""
+        """Backbone forward -> final-normed hidden states (B, T, D).
+
+        The lm_head projection is applied by `apply` (full logits, inference)
+        or fused into the chunked loss (training — ops/loss.py
+        fused_linear_cross_entropy, which avoids the (B*T, V) f32 buffer).
+
+        `layer_transform` is applied to each layer's BlockParams slice inside
+        the scan body, before use. The explicit-FSDP path
+        (parallel/shard_map_fsdp.py) passes the per-layer all-gather here, so
+        under `jax.checkpoint` the gathered weights are rematerialized (ZeRO-3
+        re-gather) rather than saved, and AD of the gather transposes to the
+        per-layer grad reduce-scatter."""
         B, T = tokens.shape
         C = config.head_dim
         if key is not None:
@@ -257,6 +296,8 @@ class GPT:
 
         def block_fn(x, block_and_key):
             block, k = block_and_key
+            if layer_transform is not None:
+                block = layer_transform(block)
             return (
                 GPT.block_apply(
                     config, block, x, key=k, inference=inference, rope=rope
@@ -265,12 +306,24 @@ class GPT:
             )
 
         if config.remat:
-            block_fn = jax.checkpoint(block_fn)
+            block_fn = jax.checkpoint(block_fn, policy=_remat_policy(config.remat_policy))
         x, _ = jax.lax.scan(
             block_fn, x, (params.blocks, layer_keys), unroll=config.scan_unroll
         )
 
-        x = rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
+        return rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
+
+    @staticmethod
+    def apply(
+        config: GPTConfig,
+        params: GPTParams,
+        tokens: Array,  # (B, T) int
+        *,
+        key: tp.Optional[KeyArray] = None,
+        inference: bool = False,
+    ) -> Array:
+        """Forward pass -> logits (B, T, V) in the params' floating dtype."""
+        x = GPT.hidden(config, params, tokens, key=key, inference=inference)
         return jnp.einsum("btd,vd->btv", x, params.lm_head)
 
     # ------------------------------------------------------------------

@@ -10,8 +10,7 @@ Semantics match the reference for val-loss parity:
     model.py:52-53).
 
 Both are elementwise+reduction ops XLA fuses into the surrounding matmuls, so
-there is no dedicated Pallas kernel for the default path; a fused variant
-lives in the flash-attention kernel where it rides the same VMEM tile.
+there is no dedicated Pallas kernel for them.
 """
 
 from __future__ import annotations

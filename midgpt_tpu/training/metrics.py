@@ -77,12 +77,43 @@ class MetricLogger:
         if self.is_main and use_wandb and _wandb is not None and not config.debug:
             import dataclasses
 
+            if resume_id is None:
+                resume_id = self._persistent_run_id()
             self._wandb = _wandb.init(
                 project="midgpt-tpu",
                 id=resume_id,
                 resume="allow",
                 config=dataclasses.asdict(config),
             )
+
+    def _persistent_run_id(self) -> tp.Optional[str]:
+        """Read or create `rundir/wandb_id.txt` so a relaunched run continues
+        the same wandb run (reference launch.py:59-68)."""
+        if not self.rundir:
+            return None
+        path = os.path.join(self.rundir, "wandb_id.txt")
+        try:
+            if self.rundir.startswith("gs://"):
+                import gcsfs
+
+                fs = gcsfs.GCSFileSystem()
+                if fs.exists(path):
+                    with fs.open(path, "r") as f:
+                        return f.read().strip()
+                run_id = _wandb.util.generate_id()
+                with fs.open(path, "w") as f:
+                    f.write(run_id)
+                return run_id
+            if os.path.exists(path):
+                with open(path) as f:
+                    return f.read().strip()
+            run_id = _wandb.util.generate_id()
+            os.makedirs(self.rundir, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(run_id)
+            return run_id
+        except Exception:
+            return None  # id persistence is best-effort; never block training
 
     def log(self, step: int, metrics: tp.Dict[str, float]) -> None:
         if not self.is_main:

@@ -44,8 +44,9 @@ def make_optimizer(
 
 def opt_step_count(opt_state: tp.Any) -> tp.Any:
     """The schedule step from a chain state (reference train.py:150-152 peeks
-    opt_state[3].count; here we search by field to survive chain reorders)."""
+    opt_state[3].count; here we match the schedule state by type to survive
+    chain reorders)."""
     for sub in opt_state:
-        if hasattr(sub, "count"):
+        if isinstance(sub, optax.ScaleByScheduleState):
             return sub.count
-    raise ValueError("no schedule state with a step count found")
+    raise ValueError("no ScaleByScheduleState found in the optimizer chain")

@@ -29,7 +29,7 @@ import optax
 from midgpt_tpu.config import ExperimentConfig
 from midgpt_tpu.data.dataset import TokenDataset
 from midgpt_tpu.models.gpt import GPT, GPTParams
-from midgpt_tpu.ops.loss import cross_entropy_loss
+from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 from midgpt_tpu.parallel.data import make_global_batch
 from midgpt_tpu.parallel.fsdp import constrain, fsdp_param_specs, named_shardings
 from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
@@ -45,15 +45,29 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     mesh,
     param_specs,
-) -> tp.Tuple[tp.Callable, tp.Callable]:
-    """Build (step, eval_loss) jitted functions."""
+) -> tp.Tuple[tp.Callable, tp.Callable, tp.Callable]:
+    """Build (step, eval_loss, eval_loss_many) jitted functions."""
     model_cfg = config.model_config
     compute_dtype = jnp.dtype(config.compute_dtype)
     G = config.g_accum_iters
 
-    def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
-        logits = GPT.apply(model_cfg, params_c, x, key=key, inference=False)
-        return cross_entropy_loss(logits, y)
+    if config.fsdp_mode == "shard_map":
+        from midgpt_tpu.parallel.shard_map_fsdp import make_shard_map_loss
+
+        _sm_loss = make_shard_map_loss(
+            model_cfg, mesh, param_specs, config.loss_chunk_tokens
+        )
+
+        def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
+            return _sm_loss(params_c, x, y, key)
+
+    else:
+
+        def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
+            h = GPT.hidden(model_cfg, params_c, x, key=key, inference=False)
+            return fused_linear_cross_entropy(
+                h, params_c.lm_head, y, config.loss_chunk_tokens
+            )
 
     def cast_compute(params: GPTParams) -> GPTParams:
         return jax.tree.map(
@@ -83,10 +97,35 @@ def make_train_step(
 
     @jax.jit
     def eval_loss(params: GPTParams, x: Array, y: Array) -> Array:
-        logits = GPT.apply(model_cfg, cast_compute(params), x, inference=True)
-        return cross_entropy_loss(logits, y)
+        params_c = cast_compute(params)
+        h = GPT.hidden(model_cfg, params_c, x, inference=True)
+        return fused_linear_cross_entropy(
+            h, params_c.lm_head, y, config.loss_chunk_tokens
+        )
 
-    return step, eval_loss
+    @jax.jit
+    def eval_loss_many(params: GPTParams, x_NBT: Array, y_NBT: Array) -> Array:
+        """Mean loss over a stacked (N, B, T) eval set in ONE program: the
+        whole eval is a device-side scan with a single host sync, vs the
+        reference's 200 sequential jit calls + float() round-trips
+        (reference train.py:107-117)."""
+        params_c = cast_compute(params)
+
+        def body(total, xy):
+            x, y = xy
+            h = GPT.hidden(model_cfg, params_c, x, inference=True)
+            return (
+                total
+                + fused_linear_cross_entropy(
+                    h, params_c.lm_head, y, config.loss_chunk_tokens
+                ),
+                None,
+            )
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (x_NBT, y_NBT))
+        return total / x_NBT.shape[0]
+
+    return step, eval_loss, eval_loss_many
 
 
 def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp.Any, tp.Any]:
@@ -120,28 +159,27 @@ def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp
 
 def evaluate(
     config: ExperimentConfig,
-    eval_loss: tp.Callable,
+    eval_loss_many: tp.Callable,
     params: GPTParams,
     dataset: TokenDataset,
     split: str,
     mesh,
     step_idx: int,
 ) -> float:
-    spec = batch_spec(with_accum=False)
+    """Sample the whole eval set on host, run it as one device program."""
+    spec = batch_spec(with_accum=True)  # leading N axis ~ the accum axis
     n = 1 if config.debug else config.eval_steps
-    total = 0.0
-    for i in range(n):
-        x, y = dataset.batch(
-            split,
-            # decorrelate eval batches from train batches and across evals
-            1_000_000_000 + step_idx * n + i,
-            config.model_config.block_size,
-            config.batch_size // jax.process_count(),
-        )
-        xg = make_global_batch(x, mesh, spec)
-        yg = make_global_batch(y, mesh, spec)
-        total += float(eval_loss(params, xg, yg))
-    return total / n
+    x, y = dataset.batch(
+        split,
+        # decorrelate eval batches from train batches and across evals
+        1_000_000_000 + step_idx,
+        config.model_config.block_size,
+        config.batch_size // jax.process_count(),
+        g_accum_iters=n,
+    )
+    xg = make_global_batch(x, mesh, spec)
+    yg = make_global_batch(y, mesh, spec)
+    return float(eval_loss_many(params, xg, yg))
 
 
 def train(config: ExperimentConfig) -> dict:
@@ -157,7 +195,7 @@ def train(config: ExperimentConfig) -> dict:
 
     params, opt_state, param_specs, optimizer = init_state(config, mesh)
     schedule = make_schedule(config)
-    step, eval_loss = make_train_step(config, optimizer, mesh, param_specs)
+    step, eval_loss, eval_loss_many = make_train_step(config, optimizer, mesh, param_specs)
     n_params = GPT.count_params(params)
     if jax.process_index() == 0:
         print(f"Model has {n_params:,} parameters.")
@@ -180,7 +218,10 @@ def train(config: ExperimentConfig) -> dict:
     logger = MetricLogger(config)
     profiler = Profiler(config.rundir, enabled=config.debug)
     data_sp = batch_spec(with_accum=True)
-    key = jax.random.PRNGKey(config.seed)
+    # Positional key stream: fold the step index into the base key so resumed
+    # runs continue the exact dropout-key sequence (the data sampler is
+    # already positional; this makes the whole step a function of `itr`).
+    base_key = jax.random.PRNGKey(config.seed)
     T = config.model_config.block_size
     metrics: tp.Dict[str, float] = {}
     import time as _time
@@ -189,10 +230,10 @@ def train(config: ExperimentConfig) -> dict:
     for itr in range(first_step, config.max_steps):
         if itr % config.eval_interval == 0:
             metrics["loss/train"] = evaluate(
-                config, eval_loss, params, dataset, "train", mesh, itr
+                config, eval_loss_many, params, dataset, "train", mesh, itr
             )
             metrics["loss/val"] = evaluate(
-                config, eval_loss, params, dataset, "val", mesh, itr
+                config, eval_loss_many, params, dataset, "val", mesh, itr
             )
             logger.log(itr, {k: metrics[k] for k in ("loss/train", "loss/val")})
             t_last, tokens_since = _time.time(), 0  # eval pauses don't count
@@ -200,7 +241,7 @@ def train(config: ExperimentConfig) -> dict:
         x, y = dataset.batch("train", itr, T, local_bs, config.g_accum_iters)
         xg = make_global_batch(x, mesh, data_sp)
         yg = make_global_batch(y, mesh, data_sp)
-        key, step_key = jax.random.split(key)
+        step_key = jax.random.fold_in(base_key, itr)
         profiler.maybe_start(itr, at_step=first_step + 1)
         params, opt_state, loss = step(params, opt_state, xg, yg, step_key)
         profiler.maybe_stop(wait_for=loss)
@@ -231,7 +272,7 @@ def train(config: ExperimentConfig) -> dict:
             mngr.save(itr, {"params": params, "opt_state": opt_state})
 
     metrics["loss/final"] = float(
-        evaluate(config, eval_loss, params, dataset, "val", mesh, config.max_steps)
+        evaluate(config, eval_loss_many, params, dataset, "val", mesh, config.max_steps)
     )
     logger.log(config.max_steps, {"loss/val_final": metrics["loss/final"]})
     logger.close()

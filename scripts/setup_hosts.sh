@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Bootstrap every host of a TPU slice for training (capability parity with
+# reference scripts/setup.sh:9-19, adapted to this package layout).
+#
+#   scripts/tpu setup <name> [data-disk]
+#
+# Steps, on every host:
+#   1. rsync this repo
+#   2. install jax[tpu] from Google's libtpu release index + requirements
+#   3. optionally attach a read-only persistent disk holding train.bin/val.bin
+#      and mount it at /mnt/disks/persist
+#
+# Requires MIDGPT_TPU_PROJECT / MIDGPT_TPU_ZONE (see scripts/tpu).
+
+set -euo pipefail
+
+NAME="${1:?usage: setup_hosts.sh <tpu-name> [data-disk]}"
+DISK="${2:-}"
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+TPU="$SCRIPT_DIR/tpu"
+
+# Stale host keys accumulate as slices are recreated with recycled IPs.
+while IFS= read -r ip; do
+    [[ -n "$ip" ]] && ssh-keygen -R "$ip" >/dev/null 2>&1 || true
+done < <("$TPU" ips "$NAME")
+
+"$TPU" copy "$NAME"
+"$TPU" ssh "$NAME" "pip install -q 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+"$TPU" ssh "$NAME" "cd repo && pip install -q -r requirements.txt"
+
+if [[ -n "$DISK" ]]; then
+    gcloud compute tpus tpu-vm attach-disk "$NAME" \
+        --project "${MIDGPT_TPU_PROJECT:?}" --zone "${MIDGPT_TPU_ZONE:?}" \
+        --disk "$DISK" --mode=read-only
+    "$TPU" ssh "$NAME" "sudo mkdir -p /mnt/disks/persist && sudo mount -o discard,defaults,ro /dev/sdb /mnt/disks/persist || true"
+fi
+
+echo "setup complete: $NAME"

@@ -1,0 +1,79 @@
+"""Worker for the 2-process distributed CPU test (run via subprocess).
+
+Each process: jax.distributed.initialize on localhost, 2 local CPU devices
+(4 global), per-process data shard via TokenDataset(shard_by_process=True),
+global batch assembly via make_global_batch, ONE compiled train step over a
+(data=2, fsdp=2) mesh. Prints `LOSS <value>` — the parent test asserts both
+processes print the same finite number (proving global-array assembly, not
+just single-process SPMD).
+
+Usage: python multiproc_worker.py <coordinator> <n_proc> <proc_id> <data_dir>
+"""
+
+import sys
+
+import jax
+
+coordinator, n_proc, proc_id, data_dir = (
+    sys.argv[1],
+    int(sys.argv[2]),
+    int(sys.argv[3]),
+    sys.argv[4],
+)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.distributed.initialize(
+    coordinator_address=coordinator, num_processes=n_proc, process_id=proc_id
+)
+
+import numpy as np
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.data.dataset import TokenDataset
+from midgpt_tpu.models.gpt import GPTConfig
+from midgpt_tpu.parallel.data import make_global_batch
+from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
+from midgpt_tpu.training.train import init_state, make_train_step
+
+assert jax.process_count() == n_proc, jax.process_count()
+assert jax.device_count() == 2 * n_proc, jax.device_count()
+
+config = ExperimentConfig(
+    rundir="",
+    data_dir=data_dir,
+    learning_rate=1e-3,
+    batch_size=8,  # global
+    warmup_steps=2,
+    min_lr=1e-4,
+    lr_decay_steps=10,
+    max_steps=10,
+    beta2=0.95,
+    weight_decay=1e-4,
+    eval_interval=5,
+    param_dtype="float32",
+    compute_dtype="float32",
+    g_accum_iters=2,
+    shard_model=True,
+    fsdp_min_size=0,
+    mesh=MeshConfig(data=2, fsdp=2, sp=1),
+    model_config=GPTConfig(block_size=32, vocab_size=64, n_layer=2, n_head=2, n_embd=32),
+)
+
+mesh = make_mesh(config.mesh)
+dataset = TokenDataset(data_dir, seed=7, shard_by_process=True)
+# each process must hold a distinct, equal-length contiguous slice
+n_total = 4096
+assert len(dataset["train"]) == n_total // n_proc, len(dataset["train"])
+
+params, opt_state, specs, optimizer = init_state(config, mesh)
+step, *_ = make_train_step(config, optimizer, mesh, specs)
+
+local_bs = config.batch_size // n_proc
+x, y = dataset.batch("train", 0, config.model_config.block_size, local_bs, config.g_accum_iters)
+xg = make_global_batch(x, mesh, batch_spec())
+yg = make_global_batch(y, mesh, batch_spec())
+assert xg.shape == (config.g_accum_iters, config.batch_size, config.model_config.block_size)
+
+params, opt_state, loss = step(params, opt_state, xg, yg, jax.random.PRNGKey(0))
+print(f"LOSS {float(loss):.6f}", flush=True)

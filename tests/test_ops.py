@@ -95,3 +95,41 @@ def test_attention_causality():
     v2 = v.at[:, :, -1].add(100.0)
     out2 = multihead_attention(q, k2, v2, impl="naive", inference=True)
     np.testing.assert_allclose(np.asarray(out1[:, :, :-1]), np.asarray(out2[:, :, :-1]), atol=1e-5)
+
+
+@pytest.mark.parametrize("chunk", [64, 256, 1000])  # 1000: bulk chunks + remainder tail
+def test_fused_linear_cross_entropy_matches_unfused(chunk):
+    from midgpt_tpu.ops.loss import fused_linear_cross_entropy
+
+    key = jax.random.PRNGKey(7)
+    kh, kw, kl = jax.random.split(key, 3)
+    B, T, D, V = 2, 128, 16, 97
+    hidden = jax.random.normal(kh, (B, T, D))
+    lm_head = jax.random.normal(kw, (V, D)) * 0.1
+    labels = jax.random.randint(kl, (B, T), 0, V)
+
+    ref = cross_entropy_loss(jnp.einsum("btd,vd->btv", hidden, lm_head), labels)
+    out = fused_linear_cross_entropy(hidden, lm_head, labels, chunk)
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
+
+
+def test_fused_linear_cross_entropy_grads_match():
+    from midgpt_tpu.ops.loss import fused_linear_cross_entropy
+
+    key = jax.random.PRNGKey(8)
+    kh, kw, kl = jax.random.split(key, 3)
+    B, T, D, V = 2, 64, 8, 33
+    hidden = jax.random.normal(kh, (B, T, D))
+    lm_head = jax.random.normal(kw, (V, D)) * 0.1
+    labels = jax.random.randint(kl, (B, T), 0, V)
+
+    def ref_loss(h, w):
+        return cross_entropy_loss(jnp.einsum("btd,vd->btv", h, w), labels)
+
+    def fused_loss(h, w):
+        return fused_linear_cross_entropy(h, w, labels, 32)
+
+    gh_ref, gw_ref = jax.grad(ref_loss, argnums=(0, 1))(hidden, lm_head)
+    gh, gw = jax.grad(fused_loss, argnums=(0, 1))(hidden, lm_head)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(gh_ref), atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref), atol=1e-6, rtol=1e-5)

@@ -82,8 +82,8 @@ def test_grad_accum_equivalence(data_dir):
     mesh = make_mesh(cfg1.mesh)
 
     params, opt_state, specs, optimizer = init_state(cfg1, mesh)
-    step1, _ = make_train_step(cfg1, optimizer, mesh, specs)
-    step2, _ = make_train_step(cfg2, optimizer, mesh, specs)
+    step1, *_ = make_train_step(cfg1, optimizer, mesh, specs)
+    step2, *_ = make_train_step(cfg2, optimizer, mesh, specs)
 
     ds = TokenDataset(str(data_dir), seed=3)
     x, y = ds.batch("train", 0, cfg1.model_config.block_size, 16, 1)  # (1, 16, T)
@@ -115,7 +115,7 @@ def test_mixed_precision_step_runs(data_dir):
     cfg = tiny_config(data_dir, compute_dtype="bfloat16", max_steps=3, eval_interval=100)
     mesh = make_mesh(cfg.mesh)
     params, opt_state, specs, optimizer = init_state(cfg, mesh)
-    step, _ = make_train_step(cfg, optimizer, mesh, specs)
+    step, *_ = make_train_step(cfg, optimizer, mesh, specs)
     ds = TokenDataset(str(data_dir), seed=3)
     x, y = ds.batch("train", 0, cfg.model_config.block_size, cfg.batch_size, 1)
     loss = None
