@@ -27,14 +27,15 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--batch", type=int, default=16)
     parser.add_argument("--attn", type=str, default=None, choices=[None, "naive", "flash", "blockwise"])
-    parser.add_argument("--remat", type=str, default="dots_attn",
-                        choices=["off", "none", "dots", "dots_attn"],
+    parser.add_argument("--remat", type=str, default="off",
+                        choices=["off", "none", "dots", "dots_attn", "flash"],
                         help="off = no per-block checkpoint; else checkpoint policy")
-    parser.add_argument("--attn-block", type=int, default=None, help="flash/blockwise tile size")
-    parser.add_argument("--unroll", type=int, default=1, help="layer-scan unroll factor")
+    parser.add_argument("--attn-block", type=int, default=1024, help="flash/blockwise tile size")
+    parser.add_argument("--unroll", type=int, default=12, help="layer-scan unroll factor")
     parser.add_argument("--profile", type=str, default=None, help="capture a trace to this dir")
+    parser.add_argument("--loss-chunk", type=int, default=None, help="fused CE chunk tokens")
     args = parser.parse_args()
 
     from midgpt_tpu.config import MeshConfig
@@ -61,6 +62,7 @@ def main() -> int:
         **({"attn_block_size": args.attn_block} if args.attn_block else {}),
     )
     config = base_config.replace(
+        **({"loss_chunk_tokens": args.loss_chunk} if args.loss_chunk else {}),
         batch_size=args.batch * n_dev,
         g_accum_iters=1,
         shard_model=n_dev > 1,

@@ -8,15 +8,24 @@ online-softmax kernels:
     sequentially over the minor dimension, so the (m, l, acc) running
     statistics live in VMEM scratch across the KV sweep of each Q tile.
     Blocks strictly above the causal diagonal are predicated off with
-    pl.when; the diagonal block is masked elementwise.
+    pl.when; diagonal-straddling blocks are masked elementwise; fully-valid
+    blocks skip the mask entirely (the common case at long T).
   * backward: two kernels — dQ (grid over KV for each Q tile) and dK/dV
     (grid over Q for each KV tile) — recomputing p = exp(s - lse) from the
-    saved log-sum-exp rather than storing T×T probabilities.
+    saved log-sum-exp rather than storing T×T probabilities. The
+    delta = rowsum(dO ⊙ O) softmax-jacobian correction is computed in-kernel
+    from the O / dO tiles already in VMEM: no separate delta pass and no
+    broadcast side buffers.
+  * lse is stored 8 lanes wide (f32), not broadcast to a 128-lane buffer —
+    16x less statistics traffic than a full-tile store.
 
 Numerics match the reference semantics: QK^T and PV matmuls run on the MXU
 in the input dtype (bf16) with float32 accumulation (preferred_element_type),
 the softmax/statistics are float32, and the 1/sqrt(C) scale is applied to the
-f32 scores exactly as reference model.py:76 does.
+f32 scores exactly as reference model.py:76 does. Masking uses large-negative
+finite values (not -inf): the running max starts at M_INIT > MASK, so
+exp(MASK - m) underflows to exactly 0 and no NaN-scrubbing selects are needed
+in the hot loop.
 
 On non-TPU backends the kernels run in Pallas interpret mode (tests);
 numerical parity against the naive path is asserted in tests/test_flash.py.
@@ -35,13 +44,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
-NEG_INF = float("-inf")
-# lane width of the statistics scratch (TPU vector registers are (8, 128))
-_STATS_LANES = 128
+# Finite stand-ins for -inf (see module docstring).
+MASK = -1.0e30
+M_INIT = -0.5e30
+# lane width of the statistics outputs/scratch (min useful; padded to a
+# 128-lane tile in VMEM but only these lanes are stored in HBM)
+_STATS_LANES = 8
 
 # Grid semantics: batch*heads and Q tiles are independent ("parallel");
-# the KV sweep is the sequential reduction dimension ("arbitrary"). Lets
-# Mosaic pipeline/parallelize grid steps instead of running them serially.
+# the KV/Q sweep of the reduction is the sequential dimension ("arbitrary").
+# Lets Mosaic pipeline/parallelize grid steps instead of running them serially.
 _COMPILER_PARAMS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
@@ -64,9 +76,42 @@ def _block_sizes(T: int, block_q: int, block_k: int) -> tp.Tuple[int, int]:
     return bq, bk
 
 
+def _masked(s: Array, iq, ik, block_q: int, block_k: int) -> Array:
+    """Apply the causal mask elementwise (straight-line select — a lax.cond
+    that skips it on fully-valid blocks measured slower end-to-end: Mosaic
+    pipelines the unconditional kernel body better than the branchy one)."""
+    row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(row >= col, s, MASK)
+
+
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
+
+
+def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, block_k):
+    """Specialization for n_k == 1 (block_k covers the whole sequence): the
+    softmax over each row is complete in one visit, so the online-softmax
+    running statistics — scratch init, alpha rescale, m/l carry, separate
+    finalize — all vanish. This is the hot configuration for T <= block_k."""
+    iq = pl.program_id(1)
+    q = q_ref[0]  # (block_q, C)
+    k = k_ref[0]  # (block_k, C)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (block_q, block_k) f32
+    s = _masked(s, iq, 0, block_q, block_k)
+    m = jnp.max(s, axis=-1)  # (block_q,) — every row has >= 1 valid key
+    p = jnp.exp(s - m[:, None])  # masked entries underflow to 0
+    l = jnp.sum(p, axis=-1)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = (pv / l[:, None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale, block_q, block_k):
@@ -76,7 +121,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
     @pl.when(ik == 0)
     def _init():
         acc_sc[:] = jnp.zeros_like(acc_sc)
-        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        m_sc[:] = jnp.full_like(m_sc, M_INIT)
         l_sc[:] = jnp.zeros_like(l_sc)
 
     # causal: KV block strictly above the diagonal contributes nothing
@@ -87,18 +132,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (block_q, block_k) f32
-
-        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(row >= col, s, NEG_INF)
+        s = _masked(s, iq, ik, block_q, block_k)
 
         m_prev = m_sc[:, 0]  # (block_q,)
         l_prev = l_sc[:, 0]
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        p = jnp.exp(s - m_new[:, None])  # rows with all -inf give exp(-inf)=0
-        p = jnp.where(s == NEG_INF, 0.0, p)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)  # underflows to 0 at first visit
+        p = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -113,7 +153,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
         l = l_sc[:, 0]
         safe_l = jnp.maximum(l, 1e-30)
         o_ref[0] = (acc_sc[:] / safe_l[:, None]).astype(o_ref.dtype)
-        lse = jnp.where(l > 0, m_sc[:, 0] + jnp.log(safe_l), NEG_INF)
+        lse = jnp.where(l > 0, m_sc[:, 0] + jnp.log(safe_l), MASK)
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
@@ -126,35 +166,48 @@ def _flash_forward(
     qf = q.reshape(B * H, T, C)
     kf = k.reshape(B * H, T, C)
     vf = v.reshape(B * H, T, C)
-    grid = (B * H, T // bq, T // bk)
+    single = T // bk == 1
+
+    if single:
+        kernel = functools.partial(_fwd_kernel_single, scale=scale, block_q=bq, block_k=bk)
+        grid = (B * H, T // bq)
+        idx_q = lambda b, iq: (b, iq, 0)
+        idx_k = lambda b, iq: (b, 0, 0)
+        scratch = []
+        params = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
+    else:
+        kernel = functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk)
+        grid = (B * H, T // bq, T // bk)
+        idx_q = lambda b, iq, ik: (b, iq, 0)
+        idx_k = lambda b, iq, ik: (b, ik, 0)
+        scratch = [
+            pltpu.VMEM((bq, C), jnp.float32),
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+        ]
+        params = _COMPILER_PARAMS
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk),
+        kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, C), idx_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, C), idx_k, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, C), idx_k, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (1, bq, _STATS_LANES), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM
-            ),
+            pl.BlockSpec((1, bq, C), idx_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _STATS_LANES), idx_q, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, C), q.dtype),
             jax.ShapeDtypeStruct((B * H, T, _STATS_LANES), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, C), jnp.float32),
-            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
-            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
-        ],
-        compiler_params=_COMPILER_PARAMS,
+        scratch_shapes=scratch,
+        compiler_params=params,
         interpret=_interpret(),
     )(qf, kf, vf)
-    return out.reshape(B, H, T, C), lse[:, :, 0].reshape(B, H, T)
+    return out.reshape(B, H, T, C), lse.reshape(B, H, T, _STATS_LANES)
 
 
 # ----------------------------------------------------------------------
@@ -162,8 +215,71 @@ def _flash_forward(
 # ----------------------------------------------------------------------
 
 
+def _bwd_fused_single(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dk_ref, dv_ref,
+    *, scale, seq_len,
+):
+    """Fully-fused backward for T <= block: computes dQ, dK and dV from ONE
+    score/probability reconstruction — versus the two-kernel split, this
+    saves a full QK^T matmul, a mask+exp pass and a second round of
+    q/k/v/o/do DMAs. Grid is (B*H,): one grid step per head."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (T, T) f32
+    s = _masked(s, 0, 0, seq_len, seq_len)
+    lse = lse_ref[0][:, 0]
+    p = jnp.exp(s - lse[:, None])  # (T, T)
+    pb = p.astype(do.dtype)
+    dv_ref[0] = jax.lax.dot_general(
+        pb, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    delta = jnp.sum(o_ref[0].astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)  # (T, T) bf16
+    dq_ref[0] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dq_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dk_ref.dtype)
+
+
+def _bwd_dq_kernel_single(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, block_q, block_k
+):
+    """n_k == 1 specialization: no accumulation scratch, one straight pass."""
+    iq = pl.program_id(1)
+    q = q_ref[0]
+    k = k_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    s = _masked(s, iq, 0, block_q, block_k)
+    lse = lse_ref[0][:, 0]
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(
+        do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    delta = jnp.sum(
+        o_ref[0].astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )
+    ds = p * (dp - delta[:, None]) * scale
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dq_ref.dtype)
+
+
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale, block_q, block_k
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_sc, delta_sc,
+    *, scale, block_q, block_k,
 ):
     iq, ik = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -171,6 +287,12 @@ def _bwd_dq_kernel(
     @pl.when(ik == 0)
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
+        # delta = rowsum(dO ⊙ O): computed once per Q tile from tiles already
+        # in VMEM (no separate pass, no broadcast side buffer)
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        delta = jnp.sum(o * do, axis=-1)  # (block_q,)
+        delta_sc[:] = jnp.broadcast_to(delta[:, None], delta_sc.shape)
 
     @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
     def _compute():
@@ -179,17 +301,14 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        masked = row >= col
+        s = _masked(s, iq, ik, block_q, block_k)
         lse = lse_ref[0][:, 0]  # (block_q,)
-        p = jnp.where(masked, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.exp(s - lse[:, None])  # masked entries underflow to 0
         do = do_ref[0]
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k)
-        delta = delta_ref[0][:, 0]  # (block_q,)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta_sc[:, 0][:, None]) * scale
         dq_sc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -201,7 +320,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc,
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, dk_sc, dv_sc,
     *, scale, block_q, block_k,
 ):
     ik, iq = pl.program_id(1), pl.program_id(2)
@@ -220,11 +339,9 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        masked = row >= col
+        s = _masked(s, iq, ik, block_q, block_k)
         lse = lse_ref[0][:, 0]
-        p = jnp.where(masked, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
+        p = jnp.exp(s - lse[:, None])  # (bq, bk)
         do = do_ref[0]
         dv_sc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -233,7 +350,9 @@ def _bwd_dkv_kernel(
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        delta = delta_ref[0][:, 0]
+        delta = jnp.sum(
+            o_ref[0].astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+        )  # (block_q,)
         ds = p * (dp - delta[:, None]) * scale  # (bq, bk)
         dk_sc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -247,37 +366,85 @@ def _bwd_dkv_kernel(
 
 
 def _flash_backward(block_q, block_k, residuals, g):
-    q, k, v, out, lse = residuals
+    q, k, v, out, lse = residuals  # q/k/v/out (B,H,T,C); lse (B,H,T,8) f32
     B, H, T, C = q.shape
     bq, bk = _block_sizes(T, block_q, block_k)
     scale = 1.0 / math.sqrt(C)
 
-    # delta_i = rowsum(dO * O): the softmax-jacobian correction term.
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # (B,H,T)
-
     qf, kf, vf = (a.reshape(B * H, T, C) for a in (q, k, v))
+    of = out.reshape(B * H, T, C)
     dof = g.reshape(B * H, T, C)
-    lsef = jnp.broadcast_to(lse.reshape(B * H, T, 1), (B * H, T, _STATS_LANES))
-    deltaf = jnp.broadcast_to(delta.reshape(B * H, T, 1), (B * H, T, _STATS_LANES))
+    lsef = lse.reshape(B * H, T, _STATS_LANES)
 
-    q_spec = pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM)
-    stat_q_spec = pl.BlockSpec(
-        (1, bq, _STATS_LANES), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM
-    )
+    if T // bk == 1 and T <= 1024:
+        # One fused kernel for the whole backward: the (T, T) f32 score tile
+        # plus its bf16 shadows fit VMEM up to T=1024.
+        full_spec = pl.BlockSpec((1, T, C), lambda b: (b, 0, 0), memory_space=pltpu.VMEM)
+        stat_spec = pl.BlockSpec(
+            (1, T, _STATS_LANES), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_single, scale=scale, seq_len=T),
+            grid=(B * H,),
+            in_specs=[full_spec] * 5 + [stat_spec],
+            out_specs=[full_spec] * 3,
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, T, C), q.dtype),
+                jax.ShapeDtypeStruct((B * H, T, C), k.dtype),
+                jax.ShapeDtypeStruct((B * H, T, C), v.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)
+            ),
+            interpret=_interpret(),
+        )(qf, kf, vf, of, dof, lsef)
+        return (
+            dq.reshape(B, H, T, C),
+            dk.reshape(B, H, T, C),
+            dv.reshape(B, H, T, C),
+        )
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk),
-        grid=(B * H, T // bq, T // bk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, stat_q_spec, stat_q_spec],
-        out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((B * H, T, C), q.dtype)],
-        scratch_shapes=[pltpu.VMEM((bq, C), jnp.float32)],
-        compiler_params=_COMPILER_PARAMS,
-        interpret=_interpret(),
-    )(qf, kf, vf, dof, lsef, deltaf)[0]
+    if T // bk == 1:  # single KV step: stateless dq kernel, 2D grid
+        q_spec = pl.BlockSpec((1, bq, C), lambda b, iq: (b, iq, 0), memory_space=pltpu.VMEM)
+        k_spec = pl.BlockSpec((1, bk, C), lambda b, iq: (b, 0, 0), memory_space=pltpu.VMEM)
+        stat_q_spec = pl.BlockSpec(
+            (1, bq, _STATS_LANES), lambda b, iq: (b, iq, 0), memory_space=pltpu.VMEM
+        )
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel_single, scale=scale, block_q=bq, block_k=bk),
+            grid=(B * H, T // bq),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, stat_q_spec],
+            out_specs=[q_spec],
+            out_shape=[jax.ShapeDtypeStruct((B * H, T, C), q.dtype)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+            interpret=_interpret(),
+        )(qf, kf, vf, of, dof, lsef)[0]
+    else:
+        q_spec = pl.BlockSpec((1, bq, C), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM)
+        k_spec = pl.BlockSpec((1, bk, C), lambda b, iq, ik: (b, ik, 0), memory_space=pltpu.VMEM)
+        stat_q_spec = pl.BlockSpec(
+            (1, bq, _STATS_LANES), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM
+        )
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk),
+            grid=(B * H, T // bq, T // bk),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, stat_q_spec],
+            out_specs=[q_spec],
+            out_shape=[jax.ShapeDtypeStruct((B * H, T, C), q.dtype)],
+            scratch_shapes=[
+                pltpu.VMEM((bq, C), jnp.float32),
+                pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+            ],
+            compiler_params=_COMPILER_PARAMS,
+            interpret=_interpret(),
+        )(qf, kf, vf, of, dof, lsef)[0]
 
-    # dk/dv: KV tile is the outer loop, Q sweep is innermost.
+    # dk/dv: KV tile is the outer loop, Q sweep is innermost. (T <= 1024
+    # always takes the fused branch above, so this is the long-context path
+    # and keeps the tiled Q sweep — a full-sequence Q block would blow the
+    # VMEM budget exactly where this branch is reachable.)
     q_spec2 = pl.BlockSpec((1, bq, C), lambda b, ik, iq: (b, iq, 0), memory_space=pltpu.VMEM)
     k_spec2 = pl.BlockSpec((1, bk, C), lambda b, ik, iq: (b, ik, 0), memory_space=pltpu.VMEM)
     stat_q_spec2 = pl.BlockSpec(
@@ -286,7 +453,7 @@ def _flash_backward(block_q, block_k, residuals, g):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq, block_k=bk),
         grid=(B * H, T // bk, T // bq),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, stat_q_spec2, stat_q_spec2],
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, stat_q_spec2],
         out_specs=[k_spec2, k_spec2],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, C), k.dtype),
@@ -298,8 +465,7 @@ def _flash_backward(block_q, block_k, residuals, g):
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lsef, deltaf)
-
+    )(qf, kf, vf, of, dof, lsef)
     return (
         dq.reshape(B, H, T, C),
         dk.reshape(B, H, T, C),
@@ -308,13 +474,13 @@ def _flash_backward(block_q, block_k, residuals, g):
 
 
 # ----------------------------------------------------------------------
-# public op
+# public ops
 # ----------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(
-    q: Array, k: Array, v: Array, block_q: int = 256, block_k: int = 256
+    q: Array, k: Array, v: Array, block_q: int = 512, block_k: int = 1024
 ) -> Array:
     """Causal flash attention over (B, H, T, C); T must divide the blocks."""
     out, _ = _flash_forward(q, k, v, block_q, block_k)
@@ -323,7 +489,31 @@ def flash_attention(
 
 def _fwd_rule(q, k, v, block_q, block_k):
     out, lse = _flash_forward(q, k, v, block_q, block_k)
+    # Named so a remat policy can keep the kernel's residuals: with
+    # {attn_out, attn_lse} (plus the rotated q/k/v named in the model) saved,
+    # the backward pass never re-runs the forward kernel.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 
 flash_attention.defvjp(_fwd_rule, _flash_backward)
+
+
+def flash_attention_bthc(
+    q: Array, k: Array, v: Array, block_q: int = 512, block_k: int = 1024
+) -> Array:
+    """(B, T, H, C) wrapper: transposes to head-major around the kernel.
+
+    Kept for sequence-major callers; the per-head (B, H, T, C) layout is the
+    primary one (Mosaic requires the last two block dims to tile cleanly,
+    which rules out singleton-head blocks on sequence-major arrays, and a
+    heads-fused sequence-major kernel measured slower than the per-head grid
+    plus explicit transposes)."""
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        block_q, block_k,
+    )
+    return out.transpose(0, 2, 1, 3)

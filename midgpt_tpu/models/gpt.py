@@ -39,7 +39,7 @@ from jax.ad_checkpoint import checkpoint_name
 from midgpt_tpu.ops.attention import multihead_attention
 from midgpt_tpu.ops.dropout import dropout
 from midgpt_tpu.ops.norms import head_layer_norm, rms_norm
-from midgpt_tpu.ops.rope import apply_rope, rope_table
+from midgpt_tpu.ops.rope import apply_rope, apply_rope_bthc, rope_table
 from midgpt_tpu.utils.pytree import pytree_dataclass
 
 Array = jax.Array
@@ -66,6 +66,11 @@ class GPTConfig:
     #             projections; attention internals still recompute — they're
     #             cheap under flash and their T×T buffers are what remat is
     #             protecting against)
+    #   'flash' — 'dots' plus the flash kernel's residuals (rotated q/k/v,
+    #             attention output and log-sum-exp): backward recomputes
+    #             nothing of attention — no transposes, no RoPE/QK-norm
+    #             replay, no forward-kernel re-run — at the cost of saving
+    #             ~4 (B,T,D)-sized buffers per layer
     remat_policy: str = "dots"
     scan_unroll: int = 1  # unroll factor of the layer scan
 
@@ -143,8 +148,20 @@ def _remat_policy(name: str):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("attn_out"),
         )
+    if name == "flash":
+        # Everything attention-shaped: rotated q/k/v (head-major, named in
+        # block_apply), the kernel's output and log-sum-exp (named in its
+        # fwd rule). Backward starts attention AD directly at the saved
+        # kernel residuals.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "q_rot", "k_rot", "v_proj", "attn_out", "attn_lse"
+            ),
+        )
     raise ValueError(
-        f"unknown remat_policy {name!r} (expected 'none', 'dots' or 'dots_attn')"
+        f"unknown remat_policy {name!r} "
+        "(expected 'none', 'dots', 'dots_attn' or 'flash')"
     )
 
 
@@ -185,16 +202,18 @@ class GPT:
     def _project_qkv(
         config: GPTConfig, block: BlockParams, h: Array
     ) -> tp.Tuple[Array, Array, Array]:
-        """h (B, T, D) -> q, k, v (B, H, T, C) after QK-LayerNorm (no RoPE)."""
+        """h (B, T, D) -> q, k, v (B, T, H, C) after QK-LayerNorm (no RoPE).
+
+        Sequence-major (B, T, H, C) is the layout the fused projection
+        produces with a plain reshape; the flash kernel consumes it natively,
+        so the training hot path never materializes a head transpose."""
         B, T, D = h.shape
         H, C = config.n_head, config.head_dim
         qkv = jnp.einsum("btd,ed->bte", h, block.attn.wqkv)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, C).transpose(0, 2, 1, 3)
-        k = k.reshape(B, T, H, C).transpose(0, 2, 1, 3)
-        v = v.reshape(B, T, H, C).transpose(0, 2, 1, 3)
-        q = head_layer_norm(q, block.attn.q_scale)
-        k = head_layer_norm(k, block.attn.k_scale)
+        q = head_layer_norm(q.reshape(B, T, H, C), block.attn.q_scale)
+        k = head_layer_norm(k.reshape(B, T, H, C), block.attn.k_scale)
+        v = v.reshape(B, T, H, C)
         return q, k, v
 
     @staticmethod
@@ -202,15 +221,15 @@ class GPT:
         config: GPTConfig,
         block: BlockParams,
         x: Array,  # (B, T, D) residual stream
-        att: Array,  # (B, H, T, C) attention output
+        att: Array,  # (B, T, H, C) attention output (sequence-major)
         *,
         k_resid: tp.Optional[KeyArray] = None,
         k_mlp: tp.Optional[KeyArray] = None,
         inference: bool = True,
     ) -> Array:
         """Shared tail of a block: merge heads, output proj, MLP, residuals."""
-        B, H, T, C = att.shape
-        att = att.transpose(0, 2, 1, 3).reshape(B, T, config.n_embd)
+        B, T, H, C = att.shape
+        att = att.reshape(B, T, config.n_embd)
         att = jnp.einsum("btd,ed->bte", att, block.attn.wo)
         att = dropout(att, config.dropout, k_resid, inference)
         x = x + att
@@ -241,20 +260,44 @@ class GPT:
             k_attn_drop = k_resid = k_mlp = None
 
         h = rms_norm(x)  # weightless, eps 1e-6
-        q, k, v = GPT._project_qkv(config, params, h)
-        q = apply_rope(q, sin, cos, positions)
-        k = apply_rope(k, sin, cos, positions)
-        att = multihead_attention(
-            q,
-            k,
-            v,
-            impl=config.attn_impl,
-            dropout_rate=config.dropout,
-            key=k_attn_drop,
-            inference=inference,
-            block_size=config.attn_block_size,
-        )
-        att = checkpoint_name(att, "attn_out")
+        q, k, v = GPT._project_qkv(config, params, h)  # (B, T, H, C)
+        q = apply_rope_bthc(q, sin, cos, positions)
+        k = apply_rope_bthc(k, sin, cos, positions)
+        from midgpt_tpu.ops.attention import flash_block_sizes, flash_kernel_usable
+
+        if (
+            config.attn_impl == "flash"
+            and (config.dropout == 0.0 or inference)  # kernel has no dropout;
+            # the dispatcher below raises for flash+dropout (training)
+            and flash_kernel_usable(x.shape[1], config.attn_block_size)
+        ):
+            # Call the kernel directly (head-major) so the post-rope tensors
+            # can be named for the 'flash' remat policy: with q/k/v saved
+            # here and out/lse saved in the kernel's fwd rule, backward
+            # resumes attention AD from residuals instead of replaying
+            # transpose+RoPE+QK-norm+kernel.
+            import importlib
+
+            fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
+            bq, bk = flash_block_sizes(x.shape[1], config.attn_block_size)
+            q = checkpoint_name(q.transpose(0, 2, 1, 3), "q_rot")
+            k = checkpoint_name(k.transpose(0, 2, 1, 3), "k_rot")
+            v = checkpoint_name(v.transpose(0, 2, 1, 3), "v_proj")
+            att = fa.flash_attention(q, k, v, bq, bk)
+            att = att.transpose(0, 2, 1, 3)
+        else:
+            att = multihead_attention(
+                q,
+                k,
+                v,
+                impl=config.attn_impl,
+                dropout_rate=config.dropout,
+                key=k_attn_drop,
+                inference=inference,
+                block_size=config.attn_block_size,
+                layout="bthc",
+            )
+            att = checkpoint_name(att, "attn_out")
         return GPT._attn_out_and_mlp(
             config, params, x, att, k_resid=k_resid, k_mlp=k_mlp, inference=inference
         )
@@ -348,16 +391,16 @@ class GPT:
 
         def block_fn(x, block: BlockParams):
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)
-            qr = apply_rope(q, rope[0], rope[1])
-            kr = apply_rope(k, rope[0], rope[1])
+            q, k, v = GPT._project_qkv(config, block, h)  # (B, T, H, C)
+            qr = apply_rope_bthc(q, rope[0], rope[1])
+            kr = apply_rope_bthc(k, rope[0], rope[1])
             att = multihead_attention(
                 qr, kr, v, impl=config.attn_impl, inference=True,
-                block_size=config.attn_block_size,
+                block_size=config.attn_block_size, layout="bthc",
             )
             x = GPT._attn_out_and_mlp(config, block, x, att)
-            # cache stores post-norm, post-RoPE keys and raw values
-            return x, (kr, v)
+            # cache stores post-norm, post-RoPE keys and raw values, head-major
+            return x, (kr.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
 
         x, (k_layers, v_layers) = jax.lax.scan(block_fn, x, params.blocks)
         pad = [(0, 0), (0, 0), (0, 0), (0, S - T), (0, 0)]
@@ -395,9 +438,10 @@ class GPT:
         def block_fn(x, block_and_cache):
             block, ck, cv = block_and_cache  # ck, cv: (B, H, S, C)
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)  # (B, H, 1, C)
-            q = apply_rope(q, sin, cos, positions)
-            k = apply_rope(k, sin, cos, positions)
+            q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
+            q = apply_rope_bthc(q, sin, cos, positions).transpose(0, 2, 1, 3)
+            k = apply_rope_bthc(k, sin, cos, positions).transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)  # all (B, H, 1, C)
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
             scores = jnp.einsum("bhqc,bhkc->bhqk", q, ck)  # (B, H, 1, S)
@@ -407,7 +451,7 @@ class GPT:
                 scores.astype(jnp.float32) / math.sqrt(C), axis=-1
             ).astype(q.dtype)
             att = jnp.einsum("bhqk,bhkc->bhqc", probs, cv)
-            x = GPT._attn_out_and_mlp(config, block, x, att)
+            x = GPT._attn_out_and_mlp(config, block, x, att.transpose(0, 2, 1, 3))
             return x, (ck, cv)
 
         x, (k_new, v_new) = jax.lax.scan(block_fn, x, (params.blocks, cache.k, cache.v))

@@ -116,6 +116,29 @@ def blockwise_causal_attention(
     return out[:, :, :T_orig]
 
 
+def flash_kernel_usable(T: int, block_size: int) -> bool:
+    """True when the Pallas kernel can serve this shape on this backend
+    (callers needing arbitrary T or non-TPU hosts get the blockwise path)."""
+    import importlib
+
+    fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
+    blk = min(block_size, T)
+    return T % blk == 0 and (jax.default_backend() == "tpu" or fa.RUN_INTERPRET_OFF_TPU)
+
+
+def flash_block_sizes(T: int, block_size: int) -> tp.Tuple[int, int]:
+    """(block_q, block_k) for the flash kernel — the single place the tile
+    policy lives. KV blocks use the largest block the sequence allows;
+    Q tiles prefer 512 (keeps the f32 score tile + scratch inside VMEM,
+    measured fastest on v5e) but fall back to block_k when 512 does not
+    divide T (e.g. T=768)."""
+    bk = min(block_size, T)
+    bq = min(512, bk)
+    if T % bq:
+        bq = bk
+    return bq, bk
+
+
 def multihead_attention(
     q: Array,
     k: Array,
@@ -126,36 +149,50 @@ def multihead_attention(
     key: tp.Optional[Array] = None,
     inference: bool = False,
     block_size: int = 512,
+    layout: str = "bhtc",
 ) -> Array:
-    """Dispatch causal attention over (B, H, T, C) tensors.
+    """Dispatch causal attention; output layout matches the input layout.
 
+    layout: 'bhtc' (head-major, what the naive/blockwise math uses) or
+    'bthc' (sequence-major — the layout the fused QKV projection produces;
+    the flash kernel consumes it natively, so the training hot path never
+    transposes heads).
     impl: 'naive' (materialized T×T, reference semantics), 'blockwise'
     (O(T) jnp online softmax), or 'flash' (Pallas TPU kernel).
     Attention-probability dropout (reference model.py:78) is only supported
     on the naive path; the fused kernels take dropout_rate == 0 (all
     openwebtext-scale reference configs train with dropout 0.0).
     """
-    if impl == "naive":
-        return naive_causal_attention(
-            q, k, v, dropout_rate=dropout_rate, key=key, inference=inference
-        )
-    if dropout_rate != 0.0 and not inference:
+    if layout not in ("bhtc", "bthc"):
+        raise ValueError(f"unknown attention layout {layout!r}")
+    if impl not in ("naive", "blockwise", "flash"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl != "naive" and dropout_rate != 0.0 and not inference:
         raise NotImplementedError(f"attention dropout requires impl='naive', got {impl!r}")
-    if impl == "blockwise":
-        return blockwise_causal_attention(q, k, v, block_size=block_size)
+
+    T = q.shape[2] if layout == "bhtc" else q.shape[1]
+    blk = min(block_size, T)
     if impl == "flash":
         import importlib
 
         # the real module (the package re-exports a same-named function)
         fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
 
-        T = q.shape[-2]
-        blk = min(block_size, T)
-        tpu_ok = jax.default_backend() == "tpu" or fa.RUN_INTERPRET_OFF_TPU
-        if T % blk != 0 or not tpu_ok:
-            # Arbitrary prompt lengths (KV-cache prefill) and non-TPU
-            # backends take the equivalent blockwise path — same online
-            # softmax, plain jnp.
-            return blockwise_causal_attention(q, k, v, block_size=block_size)
-        return fa.flash_attention(q, k, v, blk, blk)
-    raise ValueError(f"unknown attention impl {impl!r}")
+        if flash_kernel_usable(T, block_size):
+            bq, bk = flash_block_sizes(T, block_size)
+            if layout == "bthc":
+                return fa.flash_attention_bthc(q, k, v, bq, bk)
+            return fa.flash_attention(q, k, v, bq, bk)
+        # Arbitrary prompt lengths (KV-cache prefill) and non-TPU backends
+        # take the equivalent blockwise path — same online softmax, plain jnp.
+        impl = "blockwise"
+
+    if layout == "bthc":  # naive/blockwise math is head-major
+        q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    if impl == "naive":
+        out = naive_causal_attention(
+            q, k, v, dropout_rate=dropout_rate, key=key, inference=inference
+        )
+    else:
+        out = blockwise_causal_attention(q, k, v, block_size=blk)
+    return out.transpose(0, 2, 1, 3) if layout == "bthc" else out

@@ -61,3 +61,26 @@ def apply_rope(
     sin = _duplicate_pairs(sin).astype(x.dtype)
     cos = _duplicate_pairs(cos).astype(x.dtype)
     return x * cos + rotate_interleaved(x) * sin
+
+
+def apply_rope_bthc(
+    x: Array,
+    sin: Array,
+    cos: Array,
+    positions: tp.Optional[Array] = None,
+) -> Array:
+    """Rotate `x` of shape (B, T, H, C) — sequence at axis 1, heads at axis 2.
+
+    Same math as `apply_rope`, with the tables broadcast over the head axis
+    instead of the sequence axis sitting next to head_dim. This is the layout
+    the fused QKV projection produces; using it end-to-end (projection → RoPE
+    → flash kernel → merge heads) eliminates all head transposes."""
+    if positions is not None:
+        sin = jnp.take(sin, positions, axis=0)
+        cos = jnp.take(cos, positions, axis=0)
+    else:
+        sin = sin[: x.shape[1]]
+        cos = cos[: x.shape[1]]
+    sin = _duplicate_pairs(sin).astype(x.dtype)[:, None, :]  # (T, 1, C)
+    cos = _duplicate_pairs(cos).astype(x.dtype)[:, None, :]
+    return x * cos + rotate_interleaved(x) * sin

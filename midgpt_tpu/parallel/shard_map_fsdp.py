@@ -67,7 +67,7 @@ def _drop_leading(spec: P) -> P:
 
 
 def make_shard_map_loss(
-    model_cfg, mesh: Mesh, param_specs, loss_chunk_tokens: int
+    model_cfg, mesh: Mesh, param_specs, loss_chunk_tokens: int, loss_remat_chunks: bool = False
 ) -> tp.Callable:
     """Build loss_fn(params, x, y, key) -> scalar with authored collectives.
 
@@ -96,7 +96,7 @@ def make_shard_map_loss(
             inference=key is None,
             layer_transform=gather_block,
         )
-        loss = fused_linear_cross_entropy(h, full_head, y, loss_chunk_tokens)
+        loss = fused_linear_cross_entropy(h, full_head, y, loss_chunk_tokens, loss_remat_chunks)
         return jax.lax.pmean(loss, BATCH_AXES)
 
     batch_spec = P(BATCH_AXES, None)

@@ -55,7 +55,8 @@ def make_train_step(
         from midgpt_tpu.parallel.shard_map_fsdp import make_shard_map_loss
 
         _sm_loss = make_shard_map_loss(
-            model_cfg, mesh, param_specs, config.loss_chunk_tokens
+            model_cfg, mesh, param_specs, config.loss_chunk_tokens,
+            config.loss_remat_chunks,
         )
 
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
@@ -66,7 +67,8 @@ def make_train_step(
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
             h = GPT.hidden(model_cfg, params_c, x, key=key, inference=False)
             return fused_linear_cross_entropy(
-                h, params_c.lm_head, y, config.loss_chunk_tokens
+                h, params_c.lm_head, y, config.loss_chunk_tokens,
+                config.loss_remat_chunks,
             )
 
     def cast_compute(params: GPTParams) -> GPTParams:
@@ -78,22 +80,35 @@ def make_train_step(
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params: GPTParams, opt_state, x_GBT: Array, y_GBT: Array, key):
         params_c = cast_compute(params)
-
-        def microstep(grad_acc, xyk):
-            x, y, k = xyk
-            loss, grad = jax.value_and_grad(loss_fn)(params_c, x, y, k)
-            grad = constrain(grad, param_specs, mesh)
-            grad_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype), grad_acc, grad)
-            return grad_acc, loss
-
         keys = jax.random.split(key, G)
-        grad_init = jax.tree.map(jnp.zeros_like, params)
-        grad, losses = jax.lax.scan(microstep, grad_init, (x_GBT, y_GBT, keys))
-        grad = jax.tree.map(lambda g: g / G, grad)
+
+        if G == 1:
+            # No accumulation machinery: skip the zeros-init + add + divide
+            # passes over a full parameter-sized buffer (~3 HBM sweeps).
+            loss, grad = jax.value_and_grad(loss_fn)(
+                params_c, x_GBT[0], y_GBT[0], keys[0]
+            )
+            grad = constrain(grad, param_specs, mesh)
+            grad = jax.tree.map(lambda g, p: g.astype(p.dtype), grad, params)
+        else:
+
+            def microstep(grad_acc, xyk):
+                x, y, k = xyk
+                loss, grad = jax.value_and_grad(loss_fn)(params_c, x, y, k)
+                grad = constrain(grad, param_specs, mesh)
+                grad_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), grad_acc, grad
+                )
+                return grad_acc, loss
+
+            grad_init = jax.tree.map(jnp.zeros_like, params)
+            grad, losses = jax.lax.scan(microstep, grad_init, (x_GBT, y_GBT, keys))
+            grad = jax.tree.map(lambda g: g / G, grad)
+            loss = jnp.mean(losses)
         updates, opt_state = optimizer.update(grad, opt_state, params)
         params = optax.apply_updates(params, updates)
         params = constrain(params, param_specs, mesh)
-        return params, opt_state, jnp.mean(losses)
+        return params, opt_state, loss
 
     @jax.jit
     def eval_loss(params: GPTParams, x: Array, y: Array) -> Array:
@@ -117,7 +132,8 @@ def make_train_step(
             return (
                 total
                 + fused_linear_cross_entropy(
-                    h, params_c.lm_head, y, config.loss_chunk_tokens
+                    h, params_c.lm_head, y, config.loss_chunk_tokens,
+                config.loss_remat_chunks,
                 ),
                 None,
             )

@@ -26,7 +26,9 @@ done < <("$TPU" ips "$NAME")
 
 "$TPU" copy "$NAME"
 "$TPU" ssh "$NAME" "pip install -q 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
-"$TPU" ssh "$NAME" "cd repo && pip install -q -r requirements.txt"
+# `tpu copy` rsyncs the repo to ~/<basename of the local checkout>
+REPO_DIR="$(basename "$(cd "$SCRIPT_DIR/.." && pwd)")"
+"$TPU" ssh "$NAME" "cd '$REPO_DIR' && pip install -q -r requirements.txt"
 
 if [[ -n "$DISK" ]]; then
     gcloud compute tpus tpu-vm attach-disk "$NAME" \
