@@ -57,7 +57,10 @@ class GPTConfig:
     n_embd: int
     dropout: float = 0.0
     # TPU knobs (not part of the reference config surface):
-    attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash'
+    # 'ring' = sequence-parallel ring attention over the mesh 'sp' axis
+    # (parallel/ring_attention.py); the runtime injects the mesh-bound
+    # implementation via the attn_fn hook on GPT.hidden.
+    attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash' | 'ring'
     attn_block_size: int = 512  # tile size for blockwise/flash paths
     remat: bool = True  # checkpoint each block inside the layer scan
     # What the per-block checkpoint may keep instead of recomputing in bwd:
@@ -249,6 +252,7 @@ class GPT:
         inference: bool = False,
         rope: tp.Optional[tp.Tuple[Array, Array]] = None,
         positions: tp.Optional[Array] = None,
+        attn_fn: tp.Optional[tp.Callable[[Array, Array, Array], Array]] = None,
     ) -> Array:
         C = config.head_dim
         if rope is None:
@@ -265,7 +269,22 @@ class GPT:
         k = apply_rope_bthc(k, sin, cos, positions)
         from midgpt_tpu.ops.attention import flash_block_sizes, flash_kernel_usable
 
-        if (
+        if attn_fn is not None:
+            # Runtime-injected attention (e.g. mesh-bound ring attention for
+            # sequence parallelism) — head-major like the kernels.
+            if config.dropout != 0.0 and not inference:
+                raise NotImplementedError(
+                    "injected attention (attn_impl='ring') does not support "
+                    "attention-probability dropout; use attn_impl='naive' or "
+                    "set dropout=0.0"
+                )
+            att = attn_fn(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+            )
+            att = checkpoint_name(att, "attn_out").transpose(0, 2, 1, 3)
+        elif (
             config.attn_impl == "flash"
             and (config.dropout == 0.0 or inference)  # kernel has no dropout;
             # the dispatcher below raises for flash+dropout (training)
@@ -311,8 +330,15 @@ class GPT:
         key: tp.Optional[KeyArray] = None,
         inference: bool = False,
         layer_transform: tp.Optional[tp.Callable[[BlockParams], BlockParams]] = None,
+        attn_fn: tp.Optional[tp.Callable[[Array, Array, Array], Array]] = None,
     ) -> Array:
         """Backbone forward -> final-normed hidden states (B, T, D).
+
+        `attn_fn` (optional) replaces the config-dispatched attention with a
+        runtime-bound implementation — the sequence-parallel path passes the
+        mesh-bound ring attention here (attention is the only op that mixes
+        information across T; everything else is token-pointwise, so GSPMD
+        keeps those ops sharded over 'sp' without collectives).
 
         The lm_head projection is applied by `apply` (full logits, inference)
         or fused into the chunked loss (training — ops/loss.py
@@ -343,7 +369,8 @@ class GPT:
                 block = layer_transform(block)
             return (
                 GPT.block_apply(
-                    config, block, x, key=k, inference=inference, rope=rope
+                    config, block, x, key=k, inference=inference, rope=rope,
+                    attn_fn=attn_fn,
                 ),
                 None,
             )

@@ -165,8 +165,14 @@ def multihead_attention(
     """
     if layout not in ("bhtc", "bthc"):
         raise ValueError(f"unknown attention layout {layout!r}")
-    if impl not in ("naive", "blockwise", "flash"):
+    if impl not in ("naive", "blockwise", "flash", "ring"):
         raise ValueError(f"unknown attention impl {impl!r}")
+    if impl == "ring":
+        # The mesh-bound ring implementation is injected by the training
+        # runtime (GPT.hidden attn_fn). Reached without it — sampling or
+        # evaluating a ring-trained checkpoint on a single host — the
+        # unsharded math is identical to blockwise online softmax.
+        impl = "blockwise"
     if impl != "naive" and dropout_rate != 0.0 and not inference:
         raise NotImplementedError(f"attention dropout requires impl='naive', got {impl!r}")
 

@@ -68,16 +68,29 @@ def fused_linear_cross_entropy(
         label_logits = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
         return jnp.sum(lse - label_logits.astype(jnp.float32))
 
-    # Static python loop (2-8 chunks): unlike lax.map/scan there is no
-    # stacked (n_chunks, chunk, D) input copy. With remat_chunks the logits
-    # are recomputed in the backward pass (bounds live memory to one
-    # chunk×V buffer — for memory-tight shapes); without it the bf16 chunk
-    # logits are stored, which at 124M/B<=32 is cheaper than re-running the
-    # lm_head matmul + reductions (~2 HBM passes vs ~1.7 TFLOP per chunk).
+    # With remat_chunks the logits are recomputed in the backward pass
+    # (bounds live memory to one chunk×V buffer — for memory-tight shapes);
+    # without it the bf16 chunk logits are stored, which at 124M/B<=32 is
+    # cheaper than re-running the lm_head matmul + reductions (~2 HBM passes
+    # vs ~1.7 TFLOP per chunk).
     chunked = jax.checkpoint(chunk_fn) if remat_chunks else chunk_fn
     total = jnp.zeros((), jnp.float32)
-    for i in range(n_chunks):
-        total = total + chunked(h[i * chunk : (i + 1) * chunk], l[i * chunk : (i + 1) * chunk])
+    if n_chunks <= 8:
+        # Static python loop: no stacked (n_chunks, chunk, D) input copy.
+        for i in range(n_chunks):
+            total = total + chunked(
+                h[i * chunk : (i + 1) * chunk], l[i * chunk : (i + 1) * chunk]
+            )
+    else:
+        # Pod-scale batches (openwebtext_xl microsteps hit 128 chunks): one
+        # rolled lax.map body keeps HLO size and compile time bounded; the
+        # stacking copy amortizes at that scale.
+        bulk = n_chunks * chunk
+        per_chunk = jax.lax.map(
+            lambda hl: chunked(*hl),
+            (h[:bulk].reshape(n_chunks, chunk, D), l[:bulk].reshape(n_chunks, chunk)),
+        )
+        total = total + jnp.sum(per_chunk)
     if rem:  # non-divisible tail goes through the same math
         total = total + chunked(h[n_chunks * chunk :], l[n_chunks * chunk :])
     return total / N

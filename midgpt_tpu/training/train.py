@@ -51,6 +51,19 @@ def make_train_step(
     compute_dtype = jnp.dtype(config.compute_dtype)
     G = config.g_accum_iters
 
+    # Sequence parallelism: ring attention is bound to the mesh here (the
+    # model is mesh-agnostic; attention is its only cross-token op).
+    attn_fn = None
+    if model_cfg.attn_impl == "ring":
+        from midgpt_tpu.parallel.ring_attention import ring_attention_sharded
+
+        if config.fsdp_mode == "shard_map":
+            raise NotImplementedError(
+                "attn_impl='ring' requires fsdp_mode='gspmd' (the explicit "
+                "shard_map FSDP path would nest shard_maps)"
+            )
+        attn_fn = functools.partial(ring_attention_sharded, mesh=mesh)
+
     if config.fsdp_mode == "shard_map":
         from midgpt_tpu.parallel.shard_map_fsdp import make_shard_map_loss
 
@@ -65,7 +78,9 @@ def make_train_step(
     else:
 
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
-            h = GPT.hidden(model_cfg, params_c, x, key=key, inference=False)
+            h = GPT.hidden(
+                model_cfg, params_c, x, key=key, inference=False, attn_fn=attn_fn
+            )
             return fused_linear_cross_entropy(
                 h, params_c.lm_head, y, config.loss_chunk_tokens,
                 config.loss_remat_chunks,
@@ -113,7 +128,7 @@ def make_train_step(
     @jax.jit
     def eval_loss(params: GPTParams, x: Array, y: Array) -> Array:
         params_c = cast_compute(params)
-        h = GPT.hidden(model_cfg, params_c, x, inference=True)
+        h = GPT.hidden(model_cfg, params_c, x, inference=True, attn_fn=attn_fn)
         return fused_linear_cross_entropy(
             h, params_c.lm_head, y, config.loss_chunk_tokens
         )
@@ -128,7 +143,7 @@ def make_train_step(
 
         def body(total, xy):
             x, y = xy
-            h = GPT.hidden(model_cfg, params_c, x, inference=True)
+            h = GPT.hidden(model_cfg, params_c, x, inference=True, attn_fn=attn_fn)
             return (
                 total
                 + fused_linear_cross_entropy(
@@ -183,7 +198,8 @@ def evaluate(
     step_idx: int,
 ) -> float:
     """Sample the whole eval set on host, run it as one device program."""
-    spec = batch_spec(with_accum=True)  # leading N axis ~ the accum axis
+    # leading N axis ~ the accum axis; sequence shards over 'sp' when on
+    spec = batch_spec(with_accum=True, shard_seq=mesh.shape["sp"] > 1)
     n = 1 if config.debug else config.eval_steps
     x, y = dataset.batch(
         split,
@@ -233,7 +249,7 @@ def train(config: ExperimentConfig) -> dict:
 
     logger = MetricLogger(config)
     profiler = Profiler(config.rundir, enabled=config.debug)
-    data_sp = batch_spec(with_accum=True)
+    data_sp = batch_spec(with_accum=True, shard_seq=mesh.shape["sp"] > 1)
     # Positional key stream: fold the step index into the base key so resumed
     # runs continue the exact dropout-key sequence (the data sampler is
     # already positional; this makes the whole step a function of `itr`).
