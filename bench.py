@@ -36,6 +36,7 @@ def main() -> int:
     parser.add_argument("--unroll", type=int, default=12, help="layer-scan unroll factor")
     parser.add_argument("--profile", type=str, default=None, help="capture a trace to this dir")
     parser.add_argument("--loss-chunk", type=int, default=None, help="fused CE chunk tokens")
+    parser.add_argument("--seq", type=int, default=None, help="override sequence length (long-context bench)")
     args = parser.parse_args()
 
     from midgpt_tpu.config import MeshConfig
@@ -55,6 +56,7 @@ def main() -> int:
 
     model_cfg = dataclasses.replace(
         model_cfg,
+        **({"block_size": args.seq} if args.seq else {}),
         attn_impl=attn,
         remat=args.remat != "off",
         remat_policy=args.remat if args.remat != "off" else "none",

@@ -1,0 +1,38 @@
+"""7B Llama-shape at 32K context: ring attention over an 8-wide sp axis.
+
+Sequence parallelism (parallel/ring_attention.py) holds T/8 = 4096 tokens of
+K/V per device and rotates shards over ICI — no device ever materializes the
+32K x 32K scores. This shape exists in no form in the reference (its context
+is capped at 1024 by the materialized T x T buffer, reference model.py:71-73).
+"""
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPTConfig
+
+config = ExperimentConfig(
+    rundir="",
+    data_dir="/mnt/disks/persist/openwebtext",
+    learning_rate=3e-4,
+    batch_size=32,
+    warmup_steps=2000,
+    min_lr=3e-5,
+    lr_decay_steps=50_000,
+    max_steps=50_000,
+    beta2=0.95,
+    weight_decay=1e-4,
+    eval_interval=1000,
+    compute_dtype="bfloat16",
+    param_dtype="float32",
+    g_accum_iters=8,
+    shard_model=True,
+    mesh=MeshConfig(data=-1, fsdp=8, sp=8),
+    model_config=GPTConfig(
+        block_size=32768,
+        vocab_size=50304,
+        n_layer=32,
+        n_head=32,
+        n_embd=4096,
+        dropout=0.0,
+        attn_impl="ring",
+    ),
+)

@@ -1,6 +1,7 @@
 """7B Llama-shape, seq 4096, 2D data x fsdp mesh + grad accum (BASELINE.json
-configs list). Long context rides the Pallas flash-attention kernel
-(ring-attention context parallelism over mesh.sp takes over when it lands)."""
+configs list). Long context rides the Pallas flash-attention kernel; for
+contexts past what one chip's flash can hold, see llama7b_32k (ring
+attention over the sp axis)."""
 
 from midgpt_tpu.config import ExperimentConfig, MeshConfig
 from midgpt_tpu.models.gpt import GPTConfig
