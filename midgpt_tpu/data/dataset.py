@@ -42,9 +42,18 @@ def sample_batch(
     rng = rng or np.random.default_rng()
     bs = batch_size * (g_accum_iters or 1)
     starts = rng.integers(0, len(data) - block_size, size=(bs,))
-    offsets = np.arange(block_size)
-    x = data[starts[:, None] + offsets].astype(np.int32)
-    y = data[starts[:, None] + offsets + 1].astype(np.int32)
+    # One-pass native gather when the C batcher is available (built on
+    # demand, midgpt_tpu/native); numpy double-gather otherwise. The RNG
+    # stays in numpy either way, so both paths are bit-identical.
+    from midgpt_tpu import native
+
+    xy = native.sample_windows(data, starts, block_size)
+    if xy is not None:
+        x, y = xy
+    else:
+        offsets = np.arange(block_size)
+        x = data[starts[:, None] + offsets].astype(np.int32)
+        y = data[starts[:, None] + offsets + 1].astype(np.int32)
     if g_accum_iters is not None:
         x = x.reshape(g_accum_iters, batch_size, block_size)
         y = y.reshape(g_accum_iters, batch_size, block_size)

@@ -1,0 +1,117 @@
+"""Native host-runtime components (C, loaded via ctypes).
+
+The TPU compute path is JAX/XLA/Pallas; the host runtime around it is where
+native code earns its keep. Currently: the data batcher (batcher.c) — the
+only host-side work on the training hot loop.
+
+The shared library is built on demand with the system C compiler into this
+package directory (`_batcher.so`), once, at first use. No pybind11 and no
+build-system hook: ctypes + cc keeps the extension working from a plain
+checkout (and cross-compiles trivially on TPU-VM hosts via setup_hosts.sh).
+Every entry point falls back to the numpy implementation when the toolchain
+or the build is unavailable — the native path is an accelerator, never a
+requirement. Parity is asserted bit-for-bit in tests/test_native_batcher.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+import typing as tp
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "batcher.c")
+_LIB = os.path.join(_DIR, "_batcher.so")
+
+_lock = threading.Lock()
+_lib: tp.Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compiler() -> str:
+    return os.environ.get("CC") or sysconfig.get_config_var("CC") or "cc"
+
+
+def _load() -> tp.Optional[ctypes.CDLL]:
+    """Build (once) and load the shared library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+                cc = _compiler().split()[0]
+                # Build to a per-process temp name, then publish atomically:
+                # concurrent importers (pytest -n, parallel launches) must
+                # never dlopen a half-written library.
+                tmp = f"{_LIB}.{os.getpid()}.tmp"
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, _LIB)
+            lib = ctypes.CDLL(_LIB)
+            lib.sample_windows.argtypes = [
+                ctypes.c_void_p,  # data (uint16*)
+                ctypes.c_int64,  # n_windows
+                ctypes.c_int64,  # t
+                ctypes.c_void_p,  # starts (int64*)
+                ctypes.c_void_p,  # x_out (int32*)
+                ctypes.c_void_p,  # y_out (int32*)
+                ctypes.c_int64,  # n_threads
+            ]
+            lib.sample_windows.restype = None
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def sample_windows(
+    data: np.ndarray,  # uint16 token stream (memmap or RAM)
+    starts: np.ndarray,  # int64 window starts, shape (n_windows,)
+    block_size: int,
+    n_threads: tp.Optional[int] = None,
+) -> tp.Optional[tp.Tuple[np.ndarray, np.ndarray]]:
+    """(x, y) int32 windows via the C kernel; None if the library is
+    unavailable or inputs don't qualify (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None or data.dtype != np.uint16:
+        return None
+    data = np.ascontiguousarray(data) if not data.flags.c_contiguous else data
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    n = int(starts.shape[0])
+    if n and (starts.min() < 0 or int(starts.max()) + block_size >= len(data)):
+        # same failure mode as the numpy fancy-indexing path it replaces —
+        # the C kernel itself does not bounds-check
+        raise IndexError(
+            f"window out of bounds: starts in [{starts.min()}, {starts.max()}] "
+            f"+ {block_size} vs stream of {len(data)} tokens"
+        )
+    x = np.empty((n, block_size), np.int32)
+    y = np.empty((n, block_size), np.int32)
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.sample_windows(
+        data.ctypes.data_as(ctypes.c_void_p),
+        n,
+        block_size,
+        starts.ctypes.data_as(ctypes.c_void_p),
+        x.ctypes.data_as(ctypes.c_void_p),
+        y.ctypes.data_as(ctypes.c_void_p),
+        int(n_threads),
+    )
+    return x, y

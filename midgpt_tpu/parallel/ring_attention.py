@@ -53,41 +53,63 @@ def ring_attention(
     k: Array,  # (B, H, Tl, C) local key shard
     v: Array,  # (B, H, Tl, C) local value shard
     axis_name: str,
+    block_size: int = 1024,
 ) -> Array:
     """Causal attention across the `axis_name` ring. Call inside shard_map.
 
     Returns the local (B, H, Tl, C) output shard. Shards are assumed to be
     contiguous sequence chunks in axis order (chunk g holds global positions
     [g*Tl, (g+1)*Tl) — exactly what sharding the T axis of a (B, H, T, C)
-    array over `axis_name` produces)."""
+    array over `axis_name` produces).
+
+    Within each ring step, the visiting K/V shard is swept in `block_size`
+    sub-blocks through the SAME online-softmax accumulators, so peak scores
+    memory is (B, H, Tl, block_size) — not (Tl, Tl). At 32K context over
+    sp=8 that is the difference between a 512 MB and a 2 GB f32 buffer per
+    microbatch element."""
     n = jax.lax.axis_size(axis_name)
     g = jax.lax.axis_index(axis_name)  # my global chunk index
     B, H, Tl, C = q.shape
     scale = 1.0 / math.sqrt(C)
+    blk = min(block_size, Tl)
+    if Tl % blk:
+        # keep memory bounded for every shape: the largest divisor of the
+        # shard length that fits the budget (never the whole shard)
+        blk = max(d for d in range(1, blk + 1) if Tl % d == 0)
+    n_blk = Tl // blk
 
     rows = jnp.arange(Tl)[:, None]  # local row offsets
-    cols = jnp.arange(Tl)[None, :]
+    cols = jnp.arange(blk)[None, :]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def ring_step(carry, s):
-        k_cur, v_cur, m, l, acc = carry
-        j = (g - s) % n  # global chunk index of the visiting K/V shard
+    def kv_block_step(carry, kv_and_col0):
+        """One (Tl, blk) tile of scores through the running statistics."""
+        m, l, acc = carry
+        k_blk, v_blk, col0 = kv_and_col0  # (B,H,blk,C) x2, () global col base
         scores = (
-            jnp.einsum("bhqc,bhkc->bhqk", q, k_cur).astype(jnp.float32) * scale
+            jnp.einsum("bhqc,bhkc->bhqk", q, k_blk).astype(jnp.float32) * scale
         )
-        # global causal mask: (g*Tl + row) >= (j*Tl + col)
-        valid = (g * Tl + rows) >= (j * Tl + cols)
+        valid = (g * Tl + rows) >= (col0 + cols)  # global causal comparison
         scores = jnp.where(valid, scores, MASK)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new[..., None])  # masked entries underflow to 0
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkc->bhqc", p.astype(v_cur.dtype), v_cur
+            "bhqk,bhkc->bhqc", p.astype(v_blk.dtype), v_blk
         ).astype(jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    def ring_step(carry, s):
+        k_cur, v_cur, m, l, acc = carry
+        j = (g - s) % n  # global chunk index of the visiting K/V shard
+        kb = k_cur.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
+        vb = v_cur.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
+        col0 = j * Tl + blk * jnp.arange(n_blk)  # global col base per block
+        (m, l, acc), _ = jax.lax.scan(kv_block_step, (m, l, acc), (kb, vb, col0))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return (k_nxt, v_nxt, m, l, acc), None
 
     init = (
         k,
@@ -108,12 +130,13 @@ def ring_attention_sharded(
     mesh: Mesh,
     axis_name: str = "sp",
     batch_axes: tp.Tuple[str, ...] = ("data", "fsdp"),
+    block_size: int = 1024,
 ) -> Array:
     """shard_map wrapper: shards T over `axis_name`, batch over `batch_axes`,
     runs the ring, returns the (B, H, T, C) result with the same layout."""
     spec = P(batch_axes, None, axis_name, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name),
+        functools.partial(ring_attention, axis_name=axis_name, block_size=block_size),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -1,0 +1,49 @@
+"""Host-side batcher benchmark: native C kernel vs numpy double-gather.
+
+Measures the host assembly cost of pod-scale batches (the per-host work of
+openwebtext_mh-class configs). Not part of the driver bench contract.
+
+Usage: python tools/bench_batcher.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from midgpt_tpu import native
+
+
+def main():
+    data = np.random.default_rng(0).integers(0, 50304, 200_000_000).astype(np.uint16)
+    print(f"stream: {len(data)/1e6:.0f}M tokens; native={native.native_available()}")
+    for bs, T in ((256, 1024), (2048, 1024), (512, 4096)):
+        starts = np.random.default_rng(1).integers(0, len(data) - T - 1, size=bs)
+        offsets = np.arange(T)
+
+        t0 = time.perf_counter()
+        for _ in range(5):
+            x = data[starts[:, None] + offsets].astype(np.int32)
+            y = data[starts[:, None] + offsets + 1].astype(np.int32)
+        np_dt = (time.perf_counter() - t0) / 5
+
+        if native.native_available():
+            native.sample_windows(data, starts, T)  # warm (build/load)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                xn, yn = native.sample_windows(data, starts, T)
+            c_dt = (time.perf_counter() - t0) / 5
+            assert (x == xn).all() and (y == yn).all()
+            print(
+                f"B={bs:5d} T={T}: numpy {np_dt*1e3:7.1f} ms | native "
+                f"{c_dt*1e3:6.1f} ms | {np_dt/c_dt:4.1f}x"
+            )
+        else:
+            print(f"B={bs:5d} T={T}: numpy {np_dt*1e3:7.1f} ms | native n/a")
+
+
+if __name__ == "__main__":
+    main()
