@@ -1,0 +1,101 @@
+"""MetricLogger: jsonl sink + wandb run-id persistence for resume.
+
+wandb is not installed on test hosts; these tests stub the module to verify
+the resume contract (reference launch.py:59-68: a relaunched run must reuse
+the id persisted in rundir/wandb_id.txt) without the dependency.
+"""
+
+import json
+import os
+import types
+
+import midgpt_tpu.training.metrics as metrics
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPTConfig
+
+
+def _config(rundir):
+    return ExperimentConfig(
+        rundir=str(rundir),
+        data_dir="",
+        learning_rate=1e-3,
+        batch_size=8,
+        warmup_steps=1,
+        min_lr=1e-4,
+        lr_decay_steps=10,
+        max_steps=10,
+        beta2=0.95,
+        weight_decay=1e-4,
+        eval_interval=5,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=1,
+        shard_model=False,
+        mesh=MeshConfig(),
+        model_config=GPTConfig(
+            block_size=8, vocab_size=16, n_layer=1, n_head=1, n_embd=8
+        ),
+    )
+
+
+class _FakeRun:
+    def __init__(self, id):
+        self.id = id
+        self.logged = []
+
+    def log(self, m, step=None):
+        self.logged.append((step, m))
+
+    def finish(self):
+        pass
+
+
+def _fake_wandb(created):
+    fake = types.SimpleNamespace()
+    fake.util = types.SimpleNamespace(generate_id=lambda: "generated123")
+
+    def init(project=None, id=None, resume=None, config=None):
+        run = _FakeRun(id)
+        created.append(run)
+        return run
+
+    fake.init = init
+    return fake
+
+
+def test_jsonl_always_written(tmp_path):
+    logger = metrics.MetricLogger(_config(tmp_path), use_wandb=False)
+    logger.log(3, {"loss": 1.5})
+    logger.close()
+    rec = json.loads(open(tmp_path / "metrics.jsonl").read().splitlines()[0])
+    assert rec["step"] == 3 and rec["loss"] == 1.5
+
+
+def test_wandb_id_persisted_and_reused(tmp_path, monkeypatch):
+    created = []
+    monkeypatch.setattr(metrics, "_wandb", _fake_wandb(created))
+
+    # first launch: generates an id and persists it
+    logger = metrics.MetricLogger(_config(tmp_path))
+    logger.close()
+    id_file = tmp_path / "wandb_id.txt"
+    assert id_file.read_text().strip() == "generated123"
+    assert created[0].id == "generated123"
+
+    # relaunch (resume): must reuse the persisted id, not generate a new one
+    monkeypatch.setattr(
+        metrics.MetricLogger, "_persistent_run_id",
+        metrics.MetricLogger._persistent_run_id,
+    )
+    id_file.write_text("previous-run-id")
+    logger2 = metrics.MetricLogger(_config(tmp_path))
+    logger2.close()
+    assert created[1].id == "previous-run-id"
+
+
+def test_explicit_resume_id_wins(tmp_path, monkeypatch):
+    created = []
+    monkeypatch.setattr(metrics, "_wandb", _fake_wandb(created))
+    logger = metrics.MetricLogger(_config(tmp_path), resume_id="explicit-id")
+    logger.close()
+    assert created[0].id == "explicit-id"

@@ -44,8 +44,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--attn", type=str, default="flash")
-    p.add_argument("--remat", type=str, default="dots_attn")
-    p.add_argument("--attn-block", type=int, default=512)
+    p.add_argument("--remat", type=str, default="flash",
+                   choices=["off", "none", "dots", "dots_attn", "flash"])
+    p.add_argument("--attn-block", type=int, default=1024)
     args = p.parse_args()
 
     import dataclasses
