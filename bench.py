@@ -17,7 +17,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 BASELINE_MFU = 0.478  # reference 1.5B on v3-128 (BASELINE.md)

@@ -15,7 +15,6 @@ All impls take q, k, v of shape (B, H, T, C) and return (B, H, T, C).
 
 from __future__ import annotations
 
-import functools
 import math
 import typing as tp
 

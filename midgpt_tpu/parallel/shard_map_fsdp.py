@@ -31,11 +31,9 @@ the 8-device CPU mesh).
 
 from __future__ import annotations
 
-import functools
 import typing as tp
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.models.gpt import GPT, GPTParams

@@ -27,7 +27,6 @@ jax.distributed.initialize(
     coordinator_address=coordinator, num_processes=n_proc, process_id=proc_id
 )
 
-import numpy as np
 
 from midgpt_tpu.config import ExperimentConfig, MeshConfig
 from midgpt_tpu.data.dataset import TokenDataset

@@ -3,9 +3,7 @@
 rebuilding an optimizer skeleton (unlike reference sample.py:111-137)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from midgpt_tpu.config import ExperimentConfig, MeshConfig
 from midgpt_tpu.models.gpt import GPT, GPTConfig

@@ -6,9 +6,7 @@ per-layer all-gather / reduce-scatter schedule (parallel/shard_map_fsdp.py).
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from midgpt_tpu.config import ExperimentConfig, MeshConfig
 from midgpt_tpu.models.gpt import GPT, GPTConfig

@@ -4,7 +4,6 @@ reference never had — SURVEY.md §4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import PartitionSpec as P
 
 from midgpt_tpu.config import MeshConfig

@@ -1,7 +1,6 @@
 """End-to-end training tests on the virtual 8-device mesh: loss goes down,
 grad accumulation is consistent, the compiled step donates its buffers."""
 
-import dataclasses
 import os
 
 import jax
