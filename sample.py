@@ -1,7 +1,7 @@
 """Sample from a trained checkpoint (reference sample.py's surface, KV-cached).
 
     python sample.py --ckpt_dir=outputs/<run> [--start="\\n"|FILE:prompt.txt]
-        [--num_samples=10] [--max_new_tokens=500] [--temperature=0.8] [--top_k=K]
+        [--num_samples=10] [--max_new_tokens=500] [--temperature=0.8] [--top_k=K] [--top_p=P]
 
 Differences from the reference: decoding uses a static KV cache (one full
 forward for the prompt, one single-token step per new token) instead of a
@@ -25,6 +25,7 @@ def main() -> None:
     parser.add_argument("--max_new_tokens", type=int, default=500)
     parser.add_argument("--temperature", type=float, default=0.8)
     parser.add_argument("--top_k", type=int, default=None)
+    parser.add_argument("--top_p", type=float, default=None, help="nucleus sampling mass")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
@@ -97,6 +98,7 @@ def main() -> None:
         args.max_new_tokens,
         temperature=args.temperature,
         top_k=args.top_k,
+        top_p=args.top_p,
         key=jax.random.PRNGKey(args.seed),
     )
     for i in range(args.num_samples):

@@ -107,3 +107,26 @@ def test_sample_logits_modes():
     # high temperature with full vocab still returns a valid index
     idx = int(sample_logits(logits, key, temperature=5.0)[0])
     assert 0 <= idx < 4
+
+
+def test_top_p_nucleus():
+    """top-p keeps the smallest prefix of descending-prob tokens reaching p:
+    a tiny p degenerates to the argmax token; p=1.0 is a no-op filter."""
+    from midgpt_tpu.sampling.engine import sample_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # p below the top token's mass -> only token 0 survives, any key
+    for seed in range(5):
+        tok = sample_logits(logits, jax.random.PRNGKey(seed), 1.0, top_p=0.3)
+        assert int(tok[0]) == 0
+    # p covering the top two -> samples only from {0, 1}
+    seen = set()
+    for seed in range(20):
+        tok = sample_logits(logits, jax.random.PRNGKey(seed), 1.0, top_p=0.75)
+        seen.add(int(tok[0]))
+    assert seen <= {0, 1} and 0 in seen
+    # p=1.0 leaves the distribution untouched (same draws as unfiltered)
+    for seed in range(5):
+        a = sample_logits(logits, jax.random.PRNGKey(seed), 1.0, top_p=1.0)
+        b = sample_logits(logits, jax.random.PRNGKey(seed), 1.0)
+        assert int(a[0]) == int(b[0])
