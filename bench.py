@@ -24,7 +24,7 @@ BASELINE_MFU = 0.478  # reference 1.5B on v3-128 (BASELINE.md)
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--batch", type=int, default=16)
     parser.add_argument("--attn", type=str, default=None, choices=[None, "naive", "flash", "blockwise"])
