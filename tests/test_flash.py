@@ -105,3 +105,41 @@ def test_dispatch_falls_back_on_indivisible_len():
     ref = naive_causal_attention(q, k, v)
     out = multihead_attention(q, k, v, impl="flash", inference=True, block_size=64)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_backward_parity_fused_single_step():
+    """blk_k == T <= 1024 routes backward through the fully-fused dQ/dK/dV
+    kernel (one probability reconstruction) — the hot path at T=1024."""
+    q, k, v = make_qkv(jax.random.PRNGKey(5), 1, 2, 128, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, 64, 128)))
+
+    def loss_naive(q, k, v):
+        return jnp.sum(jnp.sin(naive_causal_attention(q, k, v)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5, err_msg=f"d{name}"
+        )
+
+
+def test_backward_parity_single_kv_long_seq():
+    """blk_k == T > 1024 skips the fused kernel: stateless dq-single +
+    tiled dk/dv kernels (the long-context backward split)."""
+    q, k, v = make_qkv(jax.random.PRNGKey(6), 1, 1, 2048, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, 512, 2048)))
+
+    def loss_naive(q, k, v):
+        return jnp.sum(jnp.sin(naive_causal_attention(q, k, v)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+        )
