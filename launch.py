@@ -19,24 +19,37 @@ import os
 from datetime import datetime
 
 
-def apply_override(config, dotted_key: str, raw_value: str):
-    """Set a (possibly nested) config field from a CLI string."""
-    parts = dotted_key.split(".")
-    target = config
-    for p in parts[:-1]:
-        target = getattr(target, p)
-    field = parts[-1]
-    current = getattr(target, field)
-    ftype = type(current) if current is not None else str
-    value = (raw_value.lower() in ("1", "true", "yes")) if ftype is bool else ftype(raw_value)
+def apply_overrides(config, pairs):
+    """Apply all `--set dotted.key=value` overrides in ONE rebuild.
 
-    def rebuild(obj, path, v):
-        if not path[:-1]:
-            return dataclasses.replace(obj, **{path[-1]: v})
-        child = getattr(obj, path[0])
-        return dataclasses.replace(obj, **{path[0]: rebuild(child, path[1:], v)})
+    Each touched dataclass is replaced exactly once with every override it
+    receives, so cross-field validation (__post_init__) sees the final
+    state — `--set model_config.attn_impl=flash --set
+    model_config.dropout=0.0` works in either order."""
+    tree: dict = {}
+    for dotted_key, raw_value in pairs:
+        parts = dotted_key.split(".")
+        target = config
+        for p in parts[:-1]:
+            target = getattr(target, p)
+        current = getattr(target, parts[-1])
+        ftype = type(current) if current is not None else str
+        value = (
+            raw_value.lower() in ("1", "true", "yes") if ftype is bool else ftype(raw_value)
+        )
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
 
-    return rebuild(config, parts, value)
+    def rebuild(obj, node):
+        kwargs = {
+            k: rebuild(getattr(obj, k), v) if isinstance(v, dict) else v
+            for k, v in node.items()
+        }
+        return dataclasses.replace(obj, **kwargs)
+
+    return rebuild(config, tree)
 
 
 def main() -> None:
@@ -70,9 +83,10 @@ def main() -> None:
     from midgpt_tpu.training.train import train
 
     config = load_config(args.config)
-    for kv in args.set:
-        key, _, value = kv.partition("=")
-        config = apply_override(config, key, value)
+    if args.set:
+        config = apply_overrides(
+            config, [kv.partition("=")[::2] for kv in args.set]
+        )
 
     if args.rundir is not None:
         config = config.replace(rundir=args.rundir)
