@@ -1,0 +1,44 @@
+"""launch.py --set override semantics: typed, nested, order-independent."""
+
+import importlib.util
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "launch_mod", os.path.join(os.path.dirname(__file__), "..", "launch.py")
+)
+launch_mod = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(launch_mod)
+
+from midgpt_tpu.configs.shakespeare_char import config as base
+
+
+def test_typed_nested_overrides():
+    cfg = launch_mod.apply_overrides(
+        base,
+        [("max_steps", "123"), ("model_config.n_layer", "3"), ("mesh.sp", "2"),
+         ("shard_model", "true")],
+    )
+    assert cfg.max_steps == 123 and isinstance(cfg.max_steps, int)
+    assert cfg.model_config.n_layer == 3
+    assert cfg.mesh.sp == 2
+    assert cfg.shard_model is True
+    assert base.max_steps != 123  # original untouched
+
+
+def test_cross_field_validation_sees_final_state():
+    """attn_impl=ring + dropout=0.0 must work in EITHER order (the combined
+    state is valid even though ring + the preset's dropout 0.2 is not)."""
+    for pairs in (
+        [("model_config.attn_impl", "ring"), ("model_config.dropout", "0.0")],
+        [("model_config.dropout", "0.0"), ("model_config.attn_impl", "ring")],
+    ):
+        cfg = launch_mod.apply_overrides(base, pairs)
+        assert cfg.model_config.attn_impl == "ring"
+        assert cfg.model_config.dropout == 0.0
+
+
+def test_invalid_final_state_still_rejected():
+    with pytest.raises(ValueError):
+        launch_mod.apply_overrides(base, [("model_config.attn_impl", "flash")])
