@@ -36,6 +36,13 @@ def main() -> int:
     parser.add_argument("--profile", type=str, default=None, help="capture a trace to this dir")
     parser.add_argument("--loss-chunk", type=int, default=None, help="fused CE chunk tokens")
     parser.add_argument("--seq", type=int, default=None, help="override sequence length (long-context bench)")
+    parser.add_argument(
+        "--shape", type=str, default="124m", choices=["124m", "wide"],
+        help="model shape: '124m' = GPT-2-small (C=64); 'wide' = C=128 "
+        "wide-head slice (n_embd=2048, n_head=16, reduced depth) — doubles "
+        "attention MXU utilization to probe the >=55%% MFU target",
+    )
+    parser.add_argument("--layers", type=int, default=None, help="override n_layer")
     args = parser.parse_args()
 
     from midgpt_tpu.config import MeshConfig
@@ -53,8 +60,15 @@ def main() -> int:
     attn = args.attn or ("flash" if jax.default_backend() == "tpu" else "naive")
     import dataclasses
 
+    # GPT-2-XL-shaped wide-head slice: C=128 fills the 128-lane MXU on
+    # QK^T/PV (C=64 runs it half-utilized — docs/ROADMAP.md), depth trimmed
+    # so fp32 master + Adam state + activations fit one chip's 15.75 GB.
+    shape_overrides = {"n_embd": 2048, "n_head": 16, "n_layer": 8} if args.shape == "wide" else {}
+    if args.layers:
+        shape_overrides["n_layer"] = args.layers
     model_cfg = dataclasses.replace(
         model_cfg,
+        **shape_overrides,
         **({"block_size": args.seq} if args.seq else {}),
         attn_impl=attn,
         remat=args.remat != "off",

@@ -69,10 +69,29 @@ def _interpret() -> bool:
 
 
 def _block_sizes(T: int, block_q: int, block_k: int) -> tp.Tuple[int, int]:
+    """Clamp requested block sizes to ones that tile T exactly.
+
+    Requested blocks are honored when they divide T; otherwise the KV block
+    widens to the full sequence and the Q block falls back to the KV block
+    (the dispatcher-side policy, ops.attention.flash_block_sizes, differs:
+    it always picks bq=min(512, bk) and is only reached when the block
+    divides T). Deterministic in (T, block_q, block_k), so the forward and
+    backward passes of the custom VJP always agree. Widening is capped at
+    4096: past that a single (T, T) f32 score tile cannot fit the ~16 MB
+    scoped-VMEM budget, so an explicit error beats a Mosaic compile
+    failure — long indivisible sequences belong on the blockwise path."""
     bq = min(block_q, T)
     bk = min(block_k, T)
-    if T % bq or T % bk:
-        raise ValueError(f"seq len {T} must be a multiple of block sizes ({bq}, {bk})")
+    if T % bk:
+        if T > 4096:
+            raise ValueError(
+                f"seq len {T} is not a multiple of block_k={bk} and is too "
+                "long to run as a single KV block; pass block sizes that "
+                "divide T (or use the blockwise path)"
+            )
+        bk = T
+    if T % bq:
+        bq = bk
     return bq, bk
 
 
@@ -482,7 +501,9 @@ def _flash_backward(block_q, block_k, residuals, g):
 def flash_attention(
     q: Array, k: Array, v: Array, block_q: int = 512, block_k: int = 1024
 ) -> Array:
-    """Causal flash attention over (B, H, T, C); T must divide the blocks."""
+    """Causal flash attention over (B, H, T, C). Block sizes that do not
+    tile T are adjusted by `_block_sizes` (KV block widens to T, Q block
+    falls back to the KV block) rather than raising."""
     out, _ = _flash_forward(q, k, v, block_q, block_k)
     return out
 

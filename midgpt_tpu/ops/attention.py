@@ -110,8 +110,18 @@ def blockwise_causal_attention(
         # max() guards fully-masked (padded) query rows against 0/0 NaN.
         return (acc / jnp.maximum(denom, 1e-30)[..., None]).astype(q.dtype)
 
-    outs = [q_block_fn(qi, qb[:, :, qi]) for qi in range(n_blk)]
-    out = jnp.stack(outs, axis=2).reshape(B, H, T, C)
+    if n_blk <= 8:
+        outs = [q_block_fn(qi, qb[:, :, qi]) for qi in range(n_blk)]
+        out = jnp.stack(outs, axis=2)
+    else:
+        # Long sequences (the 32K config's non-TPU path is 32+ Q blocks): one
+        # rolled body instead of n_blk unrolled copies of the KV scan in HLO
+        # — bounds compile time and program size; identical math (q_block_fn
+        # only uses qi in elementwise index comparisons).
+        out = jax.lax.map(
+            lambda qi: q_block_fn(qi, qb[:, :, qi]), jnp.arange(n_blk)
+        ).transpose(1, 2, 0, 3, 4)
+    out = out.reshape(B, H, T, C)
     return out[:, :, :T_orig]
 
 

@@ -72,7 +72,12 @@ def fused_linear_cross_entropy(
     # (bounds live memory to one chunk×V buffer — for memory-tight shapes);
     # without it the bf16 chunk logits are stored, which at 124M/B<=32 is
     # cheaper than re-running the lm_head matmul + reductions (~2 HBM passes
-    # vs ~1.7 TFLOP per chunk).
+    # vs ~1.7 TFLOP per chunk). Past the same 8-chunk threshold that flips
+    # the python loop to lax.map, remat turns on automatically: at-scale
+    # microbatches (llama7b_32k, openwebtext_xl: ~128 chunks) would otherwise
+    # keep every chunk's bf16 logits live — the full (B*T, V) buffer the
+    # fused loss exists to avoid.
+    remat_chunks = remat_chunks or n_chunks > 8
     chunked = jax.checkpoint(chunk_fn) if remat_chunks else chunk_fn
     total = jnp.zeros((), jnp.float32)
     if n_chunks <= 8:

@@ -100,13 +100,22 @@ def ring_attention(
         ).astype(jnp.float32)
         return (m_new, l_new, acc_new), None
 
+    # Recompute the (Tl, blk) probabilities in the backward pass instead of
+    # stacking them as scan residuals: without this, reverse AD through the
+    # double scan saves O(Tl * T) f32 of per-block softmax probabilities per
+    # device per layer — exactly the O(T^2) memory ring attention exists to
+    # avoid (the blockwise-backward formulation of Liu et al. recomputes p).
+    # The recompute is one extra QK^T einsum per block — the same trade the
+    # flash kernel's backward makes.
+    kv_block_step_ckpt = jax.checkpoint(kv_block_step)
+
     def ring_step(carry, s):
         k_cur, v_cur, m, l, acc = carry
         j = (g - s) % n  # global chunk index of the visiting K/V shard
         kb = k_cur.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
         vb = v_cur.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
         col0 = j * Tl + blk * jnp.arange(n_blk)  # global col base per block
-        (m, l, acc), _ = jax.lax.scan(kv_block_step, (m, l, acc), (kb, vb, col0))
+        (m, l, acc), _ = jax.lax.scan(kv_block_step_ckpt, (m, l, acc), (kb, vb, col0))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt, m, l, acc), None

@@ -90,10 +90,13 @@ def test_model_end_to_end_flash_matches_naive(force_flash_interpret):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
 
 
-def test_rejects_indivisible_seq_len():
+def test_indivisible_blocks_adjust_not_raise():
+    """Explicit block sizes that don't tile T adjust to ones that do (the
+    KV block widens to T, the Q block follows) instead of raising."""
     q, k, v = make_qkv(jax.random.PRNGKey(3), 1, 1, 96, 32)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, 64, 64)
+    ref = naive_causal_attention(q, k, v)
+    out = flash_attention(q, k, v, 64, 64)  # 96 % 64 != 0 -> blocks become (96, 96)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
 def test_dispatch_falls_back_on_indivisible_len():
@@ -142,4 +145,21 @@ def test_backward_parity_single_kv_long_seq():
     for a, b, name in zip(gf, gn, "qkv"):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_default_blocks_fallback_non_divisible_T():
+    """Direct flash_attention(q, k, v) calls with the default block sizes
+    must serve sequence lengths the defaults don't divide (e.g. T=96): the
+    KV block widens to T and the Q block follows, instead of raising."""
+    q, k, v = make_qkv(jax.random.PRNGKey(9), 1, 2, 96, 32)
+    ref = naive_causal_attention(q, k, v)
+    out = flash_attention(q, k, v)  # defaults (512, 1024) do not divide 96
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(q, k, v))), argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(naive_causal_attention(q, k, v))), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5, err_msg=f"d{name}"
         )

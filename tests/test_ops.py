@@ -69,7 +69,10 @@ def test_cross_entropy_peaked_logits():
     assert float(cross_entropy_loss(logits, labels)) < 1e-5
 
 
-@pytest.mark.parametrize("T,block", [(64, 16), (128, 128), (96, 32), (100, 32), (7, 16)])
+@pytest.mark.parametrize(
+    # (256, 16) = 16 Q blocks: exercises the rolled lax.map path (> 8 blocks)
+    "T,block", [(64, 16), (128, 128), (96, 32), (100, 32), (7, 16), (256, 16)]
+)
 def test_blockwise_attention_matches_naive(T, block):
     key = jax.random.PRNGKey(3)
     kq, kk, kv = jax.random.split(key, 3)
