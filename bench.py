@@ -124,7 +124,7 @@ def main() -> int:
     mfu = achieved / peak if peak else None
 
     result = {
-        "metric": f"train_mfu_124m_{attn}_{jax.devices()[0].platform}",
+        "metric": f"train_mfu_{args.shape}_{attn}_{jax.devices()[0].platform}",
         "value": round(mfu * 100, 2) if mfu is not None else round(tokens_per_sec, 0),
         "unit": "% MFU" if mfu is not None else "tokens/sec",
         "vs_baseline": round(mfu / BASELINE_MFU, 3) if mfu is not None else None,

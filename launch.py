@@ -34,9 +34,13 @@ def apply_overrides(config, pairs):
             target = getattr(target, p)
         current = getattr(target, parts[-1])
         ftype = type(current) if current is not None else str
-        value = (
-            raw_value.lower() in ("1", "true", "yes") if ftype is bool else ftype(raw_value)
-        )
+        if ftype is bool or raw_value.lower() in ("true", "false"):
+            value = raw_value.lower() in ("1", "true", "yes")
+        elif raw_value.lower() in ("none", "null"):
+            # tri-state fields (e.g. loss_remat_chunks) default to None
+            value = None
+        else:
+            value = ftype(raw_value)
         node = tree
         for p in parts[:-1]:
             node = node.setdefault(p, {})

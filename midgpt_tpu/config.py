@@ -61,10 +61,10 @@ class ExperimentConfig:
     # f32 logits buffer to chunk×V instead of B·T×V.
     loss_chunk_tokens: int = 8192
     # Recompute chunk logits in backward (caps live memory at one chunk x V
-    # buffer). Off by default: storing the bf16 chunk logits is faster than
-    # re-running the lm_head matmul at single-chip scales; turn on for
-    # memory-tight shapes (large per-chip batch / vocab).
-    loss_remat_chunks: bool = False
+    # buffer). None = auto (on past 8 chunks per microbatch — ops/loss.py);
+    # False forces storing the bf16 chunk logits (faster at single-chip
+    # scales), True forces recompute for memory-tight shapes.
+    loss_remat_chunks: tp.Optional[bool] = None
     # FSDP collective authoring: 'gspmd' = sharding constraints, compiler
     # chooses collectives (reference parity); 'shard_map' = explicit per-layer
     # all-gather / grad reduce-scatter (parallel/shard_map_fsdp.py).

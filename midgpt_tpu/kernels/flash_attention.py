@@ -76,22 +76,24 @@ def _block_sizes(T: int, block_q: int, block_k: int) -> tp.Tuple[int, int]:
     (the dispatcher-side policy, ops.attention.flash_block_sizes, differs:
     it always picks bq=min(512, bk) and is only reached when the block
     divides T). Deterministic in (T, block_q, block_k), so the forward and
-    backward passes of the custom VJP always agree. Widening is capped at
-    4096: past that a single (T, T) f32 score tile cannot fit the ~16 MB
-    scoped-VMEM budget, so an explicit error beats a Mosaic compile
-    failure — long indivisible sequences belong on the blockwise path."""
+    backward passes of the custom VJP always agree. Widened blocks are
+    bounded by the f32 score-tile budget (bq*bk <= 1M elements = 4 MB, the
+    size the fused T=1024 backward already proves fits the ~16 MB scoped
+    VMEM alongside its operand tiles): past that, an explicit error beats a
+    Mosaic compile failure — long indivisible sequences belong on the
+    blockwise path."""
     bq = min(block_q, T)
     bk = min(block_k, T)
     if T % bk:
-        if T > 4096:
-            raise ValueError(
-                f"seq len {T} is not a multiple of block_k={bk} and is too "
-                "long to run as a single KV block; pass block sizes that "
-                "divide T (or use the blockwise path)"
-            )
         bk = T
     if T % bq:
         bq = bk
+    if bq * bk > 1024 * 1024:
+        raise ValueError(
+            f"blocks ({bq}, {bk}) for seq len {T} need a {bq}x{bk} f32 "
+            "score tile that cannot fit VMEM; pass block sizes that divide "
+            "T (or use the blockwise path)"
+        )
     return bq, bk
 
 
@@ -109,18 +111,23 @@ def _masked(s: Array, iq, ik, block_q: int, block_k: int) -> Array:
 # ----------------------------------------------------------------------
 
 
-def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, block_k):
+def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, block_k, causal):
     """Specialization for n_k == 1 (block_k covers the whole sequence): the
     softmax over each row is complete in one visit, so the online-softmax
     running statistics — scratch init, alpha rescale, m/l carry, separate
-    finalize — all vanish. This is the hot configuration for T <= block_k."""
+    finalize — all vanish. This is the hot configuration for T <= block_k.
+
+    causal=False computes full (unmasked) attention — the off-diagonal
+    pair case of ring attention, where the causal structure is decided per
+    K/V shard at the ring level, not per element."""
     iq = pl.program_id(1)
     q = q_ref[0]  # (block_q, C)
     k = k_ref[0]  # (block_k, C)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # (block_q, block_k) f32
-    s = _masked(s, iq, 0, block_q, block_k)
+    if causal:
+        s = _masked(s, iq, 0, block_q, block_k)
     m = jnp.max(s, axis=-1)  # (block_q,) — every row has >= 1 valid key
     p = jnp.exp(s - m[:, None])  # masked entries underflow to 0
     l = jnp.sum(p, axis=-1)
@@ -133,7 +140,7 @@ def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, b
     lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale, block_q, block_k, causal):
     iq, ik = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
 
@@ -143,15 +150,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
         m_sc[:] = jnp.full_like(m_sc, M_INIT)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    # causal: KV block strictly above the diagonal contributes nothing
-    @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
     def _compute():
         q = q_ref[0]  # (block_q, C)
         k = k_ref[0]  # (block_k, C)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (block_q, block_k) f32
-        s = _masked(s, iq, ik, block_q, block_k)
+        if causal:
+            s = _masked(s, iq, ik, block_q, block_k)
 
         m_prev = m_sc[:, 0]  # (block_q,)
         l_prev = l_sc[:, 0]
@@ -167,6 +173,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
         m_sc[:] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
         l_sc[:] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
 
+    if causal:
+        # causal: KV block strictly above the diagonal contributes nothing
+        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
+
     @pl.when(ik == n_k - 1)
     def _finalize():
         l = l_sc[:, 0]
@@ -177,7 +189,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
 
 
 def _flash_forward(
-    q: Array, k: Array, v: Array, block_q: int, block_k: int
+    q: Array, k: Array, v: Array, block_q: int, block_k: int, causal: bool = True
 ) -> tp.Tuple[Array, Array]:
     B, H, T, C = q.shape
     bq, bk = _block_sizes(T, block_q, block_k)
@@ -188,14 +200,18 @@ def _flash_forward(
     single = T // bk == 1
 
     if single:
-        kernel = functools.partial(_fwd_kernel_single, scale=scale, block_q=bq, block_k=bk)
+        kernel = functools.partial(
+            _fwd_kernel_single, scale=scale, block_q=bq, block_k=bk, causal=causal
+        )
         grid = (B * H, T // bq)
         idx_q = lambda b, iq: (b, iq, 0)
         idx_k = lambda b, iq: (b, 0, 0)
         scratch = []
         params = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
     else:
-        kernel = functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk)
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal
+        )
         grid = (B * H, T // bq, T // bk)
         idx_q = lambda b, iq, ik: (b, iq, 0)
         idx_k = lambda b, iq, ik: (b, ik, 0)
@@ -236,7 +252,7 @@ def _flash_forward(
 
 def _bwd_fused_single(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dk_ref, dv_ref,
-    *, scale, seq_len,
+    *, scale, seq_len, causal,
 ):
     """Fully-fused backward for T <= block: computes dQ, dK and dV from ONE
     score/probability reconstruction — versus the two-kernel split, this
@@ -249,7 +265,8 @@ def _bwd_fused_single(
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # (T, T) f32
-    s = _masked(s, 0, 0, seq_len, seq_len)
+    if causal:
+        s = _masked(s, 0, 0, seq_len, seq_len)
     lse = lse_ref[0][:, 0]
     p = jnp.exp(s - lse[:, None])  # (T, T)
     pb = p.astype(do.dtype)
@@ -270,7 +287,7 @@ def _bwd_fused_single(
 
 
 def _bwd_dq_kernel_single(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, block_q, block_k
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, block_q, block_k, causal
 ):
     """n_k == 1 specialization: no accumulation scratch, one straight pass."""
     iq = pl.program_id(1)
@@ -280,7 +297,8 @@ def _bwd_dq_kernel_single(
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    s = _masked(s, iq, 0, block_q, block_k)
+    if causal:
+        s = _masked(s, iq, 0, block_q, block_k)
     lse = lse_ref[0][:, 0]
     p = jnp.exp(s - lse[:, None])
     dp = jax.lax.dot_general(
@@ -298,7 +316,7 @@ def _bwd_dq_kernel_single(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_sc, delta_sc,
-    *, scale, block_q, block_k,
+    *, scale, block_q, block_k, causal,
 ):
     iq, ik = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -313,14 +331,14 @@ def _bwd_dq_kernel(
         delta = jnp.sum(o * do, axis=-1)  # (block_q,)
         delta_sc[:] = jnp.broadcast_to(delta[:, None], delta_sc.shape)
 
-    @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
     def _compute():
         q = q_ref[0]
         k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        s = _masked(s, iq, ik, block_q, block_k)
+        if causal:
+            s = _masked(s, iq, ik, block_q, block_k)
         lse = lse_ref[0][:, 0]  # (block_q,)
         p = jnp.exp(s - lse[:, None])  # masked entries underflow to 0
         do = do_ref[0]
@@ -333,6 +351,11 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
+
     @pl.when(ik == n_k - 1)
     def _finalize():
         dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
@@ -340,7 +363,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, dk_sc, dv_sc,
-    *, scale, block_q, block_k,
+    *, scale, block_q, block_k, causal,
 ):
     ik, iq = pl.program_id(1), pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -350,15 +373,14 @@ def _bwd_dkv_kernel(
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    # causal: only Q blocks at/below the diagonal see this KV block
-    @pl.when(iq * block_q + (block_q - 1) >= ik * block_k)
     def _compute():
         q = q_ref[0]
         k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        s = _masked(s, iq, ik, block_q, block_k)
+        if causal:
+            s = _masked(s, iq, ik, block_q, block_k)
         lse = lse_ref[0][:, 0]
         p = jnp.exp(s - lse[:, None])  # (bq, bk)
         do = do_ref[0]
@@ -378,13 +400,19 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # (bk, C)
 
+    if causal:
+        # causal: only Q blocks at/below the diagonal see this KV block
+        pl.when(iq * block_q + (block_q - 1) >= ik * block_k)(_compute)
+    else:
+        _compute()
+
     @pl.when(iq == n_q - 1)
     def _finalize():
         dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(block_q, block_k, residuals, g):
+def _flash_backward(block_q, block_k, residuals, g, causal=True):
     q, k, v, out, lse = residuals  # q/k/v/out (B,H,T,C); lse (B,H,T,8) f32
     B, H, T, C = q.shape
     bq, bk = _block_sizes(T, block_q, block_k)
@@ -403,7 +431,7 @@ def _flash_backward(block_q, block_k, residuals, g):
             (1, T, _STATS_LANES), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
         )
         dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_single, scale=scale, seq_len=T),
+            functools.partial(_bwd_fused_single, scale=scale, seq_len=T, causal=causal),
             grid=(B * H,),
             in_specs=[full_spec] * 5 + [stat_spec],
             out_specs=[full_spec] * 3,
@@ -430,7 +458,7 @@ def _flash_backward(block_q, block_k, residuals, g):
             (1, bq, _STATS_LANES), lambda b, iq: (b, iq, 0), memory_space=pltpu.VMEM
         )
         dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel_single, scale=scale, block_q=bq, block_k=bk),
+            functools.partial(_bwd_dq_kernel_single, scale=scale, block_q=bq, block_k=bk, causal=causal),
             grid=(B * H, T // bq),
             in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, stat_q_spec],
             out_specs=[q_spec],
@@ -447,7 +475,7 @@ def _flash_backward(block_q, block_k, residuals, g):
             (1, bq, _STATS_LANES), lambda b, iq, ik: (b, iq, 0), memory_space=pltpu.VMEM
         )
         dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk),
+            functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal),
             grid=(B * H, T // bq, T // bk),
             in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, stat_q_spec],
             out_specs=[q_spec],
@@ -470,7 +498,7 @@ def _flash_backward(block_q, block_k, residuals, g):
         (1, bq, _STATS_LANES), lambda b, ik, iq: (b, iq, 0), memory_space=pltpu.VMEM
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq, block_k=bk),
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal),
         grid=(B * H, T // bk, T // bq),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, stat_q_spec2],
         out_specs=[k_spec2, k_spec2],

@@ -9,6 +9,8 @@ with the lm_head matmul's epilogue.
 
 from __future__ import annotations
 
+import typing as tp
+
 import jax
 import jax.numpy as jnp
 
@@ -28,7 +30,7 @@ def fused_linear_cross_entropy(
     lm_head: Array,
     labels: Array,
     chunk_tokens: int = 8192,
-    remat_chunks: bool = False,
+    remat_chunks: tp.Optional[bool] = None,
 ) -> Array:
     """Mean CE of `hidden @ lm_head.T` against integer labels WITHOUT ever
     materializing the full (B*T, V) float32 logits.
@@ -72,12 +74,14 @@ def fused_linear_cross_entropy(
     # (bounds live memory to one chunk×V buffer — for memory-tight shapes);
     # without it the bf16 chunk logits are stored, which at 124M/B<=32 is
     # cheaper than re-running the lm_head matmul + reductions (~2 HBM passes
-    # vs ~1.7 TFLOP per chunk). Past the same 8-chunk threshold that flips
-    # the python loop to lax.map, remat turns on automatically: at-scale
-    # microbatches (llama7b_32k, openwebtext_xl: ~128 chunks) would otherwise
-    # keep every chunk's bf16 logits live — the full (B*T, V) buffer the
-    # fused loss exists to avoid.
-    remat_chunks = remat_chunks or n_chunks > 8
+    # vs ~1.7 TFLOP per chunk). Default (None) is auto: past the same
+    # 8-chunk threshold that flips the python loop to lax.map, remat turns
+    # on — at-scale microbatches (llama7b_32k, openwebtext_xl: ~128 chunks)
+    # would otherwise keep every chunk's bf16 logits live, the full
+    # (B*T, V) buffer the fused loss exists to avoid. An explicit
+    # True/False always wins (the A/B knob stays honest).
+    if remat_chunks is None:
+        remat_chunks = n_chunks > 8
     chunked = jax.checkpoint(chunk_fn) if remat_chunks else chunk_fn
     total = jnp.zeros((), jnp.float32)
     if n_chunks <= 8:

@@ -2,31 +2,46 @@
 
 Makes the mesh's `sp` (sequence-parallel) axis real: each device holds a
 contiguous (B, H, T/n, C) shard of Q/K/V; K/V shards rotate around the ring
-with `jax.lax.ppermute` while every device accumulates online-softmax
-statistics of its local queries against each visiting K/V shard. After n
-steps every query has seen every key once — attention over the full sequence
-with O(T/n) activation memory per device and only neighbor-to-neighbor ICI
-traffic (the ppermute rides the ring; there is no all-gather of the sequence).
+with `jax.lax.ppermute` while every device merges online-softmax statistics
+of its local queries against each visiting K/V shard. After n steps every
+query has seen every key once — attention over the full sequence with O(T/n)
+activation memory per device and only neighbor-to-neighbor ICI traffic.
 
 This is the long-context scaling story the reference lacks entirely (its
 attention materializes the full T x T scores on every device, reference
 model.py:71-73, and its sequence axis is never sharded, reference
 train.py:105). Design follows the blockwise/ring formulation of Liu et al.
-(Ring Attention with Blockwise Transformers) re-expressed as a `lax.scan` of
-shard-local blockwise attention + ppermute so it is reverse-differentiable
-(jax transposes ppermute through AD; a fori_loop would not be).
+(Ring Attention with Blockwise Transformers), structured TPU-first:
 
-Causal masking across shards is an index comparison on GLOBAL positions:
-a visiting K/V shard j contributes fully when j < my shard index, the causal
-triangle when j == mine, and nothing when j > mine (those steps still run —
-shapes under scan are static — but their probabilities underflow to exactly 0
-through the same finite-mask trick the flash kernel uses).
+  * The causal structure is decided PER PAIR of shards, not per element:
+    with contiguous sequence chunks in ring order, the local (diagonal)
+    pair is ordinary causal attention, a visiting shard j < mine is fully
+    valid (NO mask — full-attention kernel), and j > mine contributes
+    nothing (its statistics are multiplied out at merge time; the compute
+    still runs because shapes under `lax.scan` are static). So the per-pair
+    compute is served by the SAME Pallas flash kernels as the dense path
+    (kernels/flash_attention.py with causal=True/False) — on a real sp>1
+    slice the per-pair attention runs at kernel speed, not jnp speed.
+  * The whole ring is one `jax.custom_vjp`: forward saves only
+    (q, k, v, out, lse) — O(T/n · C) per device. The backward pass is a
+    second authored ring pass: dK/dV accumulators rotate WITH the visiting
+    K/V shards (n rotations total brings them home), per-pair grads come
+    from the flash backward kernels reconstructing p = exp(s − lse_global),
+    and dQ accumulates locally. No AD through the scan, so nothing is
+    stacked — this is the blockwise-backward of the paper, written down.
+  * Per-pair partials merge through log-sum-exp statistics in f32:
+    out = Σ_j out_j · exp(lse_j − lse_total), with lse_j = MASK for invalid
+    pairs (the same finite-mask trick as the kernels: exp underflows to
+    exactly 0, no NaN-scrubbing selects).
+
+Off-TPU the per-pair compute falls back to the equivalent blockwise jnp
+online-softmax (`use_kernel=False`, auto-selected; tests force the kernel
+path in interpret mode for parity coverage).
 
 Use `ring_attention` inside `shard_map` (it needs a named axis); the
 `ring_attention_sharded` wrapper applies the shard_map given a mesh and spec.
 Numerics: scores/statistics in float32, matmuls in the input dtype — same
-contract as ops/attention.py. Per visiting shard, scores are (B, H, T/n, T/n)
-— blockwise memory, not O(T^2).
+contract as ops/attention.py.
 """
 
 from __future__ import annotations
@@ -39,97 +54,253 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+import importlib
+
+# the real module (the kernels package re-exports a same-named function)
+fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
+from midgpt_tpu.ops.attention import flash_block_sizes
+
 Array = jax.Array
 
-# Finite stand-ins for -inf (same scheme as kernels/flash_attention.py:
-# masked scores get MASK, running max starts at M_INIT > MASK, so
-# exp(MASK - m) == 0 exactly, even for all-masked ring steps).
+# Finite stand-ins for -inf (same scheme as kernels/flash_attention.py).
 MASK = -1.0e30
 M_INIT = -0.5e30
 
 
-def ring_attention(
-    q: Array,  # (B, H, Tl, C) local query shard
-    k: Array,  # (B, H, Tl, C) local key shard
-    v: Array,  # (B, H, Tl, C) local value shard
-    axis_name: str,
-    block_size: int = 1024,
-) -> Array:
-    """Causal attention across the `axis_name` ring. Call inside shard_map.
+def _auto_use_kernel() -> bool:
+    """Kernel per-pair compute on TPU (or when tests force interpret mode)."""
+    return jax.default_backend() == "tpu" or fa.RUN_INTERPRET_OFF_TPU
 
-    Returns the local (B, H, Tl, C) output shard. Shards are assumed to be
-    contiguous sequence chunks in axis order (chunk g holds global positions
-    [g*Tl, (g+1)*Tl) — exactly what sharding the T axis of a (B, H, T, C)
-    array over `axis_name` produces).
 
-    Within each ring step, the visiting K/V shard is swept in `block_size`
-    sub-blocks through the SAME online-softmax accumulators, so peak scores
-    memory is (B, H, Tl, block_size) — not (Tl, Tl). At 32K context over
-    sp=8 that is the difference between a 512 MB and a 2 GB f32 buffer per
-    microbatch element."""
-    n = jax.lax.axis_size(axis_name)
-    g = jax.lax.axis_index(axis_name)  # my global chunk index
-    B, H, Tl, C = q.shape
-    scale = 1.0 / math.sqrt(C)
+def _divisor_block(Tl: int, block_size: int) -> int:
     blk = min(block_size, Tl)
     if Tl % blk:
-        # keep memory bounded for every shape: the largest divisor of the
-        # shard length that fits the budget (never the whole shard)
         blk = max(d for d in range(1, blk + 1) if Tl % d == 0)
-    n_blk = Tl // blk
+    return blk
 
-    rows = jnp.arange(Tl)[:, None]  # local row offsets
+
+# ----------------------------------------------------------------------
+# per-pair attention: local q against one visiting K/V shard
+# ----------------------------------------------------------------------
+
+
+def _pair_fwd_jnp(
+    q: Array, k: Array, v: Array, causal: bool, block_size: int
+) -> tp.Tuple[Array, Array]:
+    """Blockwise online-softmax pair attention -> (out, lse (B,H,Tl) f32)."""
+    B, H, Tl, C = q.shape
+    scale = 1.0 / math.sqrt(C)
+    blk = _divisor_block(Tl, block_size)
+    n_blk = Tl // blk
+    rows = jnp.arange(Tl)[:, None]
     cols = jnp.arange(blk)[None, :]
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def kv_block_step(carry, kv_and_col0):
-        """One (Tl, blk) tile of scores through the running statistics."""
         m, l, acc = carry
-        k_blk, v_blk, col0 = kv_and_col0  # (B,H,blk,C) x2, () global col base
-        scores = (
+        k_blk, v_blk, col0 = kv_and_col0
+        s = (
             jnp.einsum("bhqc,bhkc->bhqk", q, k_blk).astype(jnp.float32) * scale
         )
-        valid = (g * Tl + rows) >= (col0 + cols)  # global causal comparison
-        scores = jnp.where(valid, scores, MASK)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        if causal:
+            s = jnp.where(rows >= (col0 + cols), s, MASK)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])  # masked entries underflow to 0
+        p = jnp.exp(s - m_new[..., None])  # masked entries underflow to 0
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhqk,bhkc->bhqc", p.astype(v_blk.dtype), v_blk
         ).astype(jnp.float32)
         return (m_new, l_new, acc_new), None
 
-    # Recompute the (Tl, blk) probabilities in the backward pass instead of
-    # stacking them as scan residuals: without this, reverse AD through the
-    # double scan saves O(Tl * T) f32 of per-block softmax probabilities per
-    # device per layer — exactly the O(T^2) memory ring attention exists to
-    # avoid (the blockwise-backward formulation of Liu et al. recomputes p).
-    # The recompute is one extra QK^T einsum per block — the same trade the
-    # flash kernel's backward makes.
-    kv_block_step_ckpt = jax.checkpoint(kv_block_step)
-
-    def ring_step(carry, s):
-        k_cur, v_cur, m, l, acc = carry
-        j = (g - s) % n  # global chunk index of the visiting K/V shard
-        kb = k_cur.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
-        vb = v_cur.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
-        col0 = j * Tl + blk * jnp.arange(n_blk)  # global col base per block
-        (m, l, acc), _ = jax.lax.scan(kv_block_step_ckpt, (m, l, acc), (kb, vb, col0))
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m, l, acc), None
-
+    kb = k.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
+    col0 = blk * jnp.arange(n_blk)
     init = (
-        k,
-        v,
         jnp.full((B, H, Tl), M_INIT, jnp.float32),
         jnp.zeros((B, H, Tl), jnp.float32),
         jnp.zeros((B, H, Tl, C), jnp.float32),
     )
-    (k, v, m, l, acc), _ = jax.lax.scan(ring_step, init, jnp.arange(n))
-    # every global row has >= 1 valid key under causal masking, so l > 0
-    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    (m, l, acc), _ = jax.lax.scan(kv_block_step, init, (kb, vb, col0))
+    # every row has >= 1 valid key in both pair cases (diagonal: itself)
+    out = (acc / l[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l)
+
+
+def _pair_bwd_jnp(
+    q, k, v, out, do, lse, delta, causal: bool, block_size: int
+) -> tp.Tuple[Array, Array, Array]:
+    """Pair backward from global statistics: p = exp(s - lse), delta global.
+
+    Blockwise over the visiting shard's KV blocks (bounds scores memory to
+    (Tl, blk), matching the forward)."""
+    B, H, Tl, C = q.shape
+    scale = 1.0 / math.sqrt(C)
+    blk = _divisor_block(Tl, block_size)
+    n_blk = Tl // blk
+    rows = jnp.arange(Tl)[:, None]
+    cols = jnp.arange(blk)[None, :]
+
+    def kv_block_step(dq_acc, kv_and_col0):
+        k_blk, v_blk, col0 = kv_and_col0
+        s = (
+            jnp.einsum("bhqc,bhkc->bhqk", q, k_blk).astype(jnp.float32) * scale
+        )
+        if causal:
+            s = jnp.where(rows >= (col0 + cols), s, MASK)
+        p = jnp.exp(s - lse[..., None])  # masked entries underflow to 0
+        dv_blk = jnp.einsum("bhqk,bhqc->bhkc", p.astype(do.dtype), do)
+        dp = jnp.einsum("bhqc,bhkc->bhqk", do, v_blk).astype(jnp.float32)
+        ds = (p * (dp - delta[..., None]) * scale).astype(q.dtype)
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkc->bhqc", ds, k_blk).astype(
+            jnp.float32
+        )
+        dk_blk = jnp.einsum("bhqk,bhqc->bhkc", ds, q)
+        return dq_acc, (dk_blk, dv_blk)
+
+    kb = k.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
+    col0 = blk * jnp.arange(n_blk)
+    dq, (dkb, dvb) = jax.lax.scan(
+        kv_block_step, jnp.zeros((B, H, Tl, C), jnp.float32), (kb, vb, col0)
+    )
+    dk = dkb.transpose(1, 2, 0, 3, 4).reshape(B, H, Tl, C)
+    dv = dvb.transpose(1, 2, 0, 3, 4).reshape(B, H, Tl, C)
+    return dq, dk.astype(jnp.float32), dv.astype(jnp.float32)
+
+
+def _pair_fwd(q, k, v, causal: bool, block_size: int, use_kernel: bool):
+    if use_kernel:
+        Tl = q.shape[2]
+        bq, bk = flash_block_sizes(Tl, block_size)
+        out, lse8 = fa._flash_forward(q, k, v, bq, bk, causal=causal)
+        return out, lse8[..., 0]
+    return _pair_fwd_jnp(q, k, v, causal, block_size)
+
+
+def _pair_bwd(q, k, v, out, do, lse, delta, causal: bool, block_size: int, use_kernel: bool):
+    if use_kernel:
+        Tl = q.shape[2]
+        bq, bk = flash_block_sizes(Tl, block_size)
+        lse8 = jnp.broadcast_to(lse[..., None], (*lse.shape, fa._STATS_LANES))
+        dq, dk, dv = fa._flash_backward(
+            bq, bk, (q, k, v, out, lse8), do, causal=causal
+        )
+        return dq.astype(jnp.float32), dk.astype(jnp.float32), dv.astype(jnp.float32)
+    return _pair_bwd_jnp(q, k, v, out, do, lse, delta, causal, block_size)
+
+
+# ----------------------------------------------------------------------
+# the ring
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(
+    q: Array,  # (B, H, Tl, C) local query shard
+    k: Array,  # (B, H, Tl, C) local key shard
+    v: Array,  # (B, H, Tl, C) local value shard
+    axis_name: str,
+    block_size: int = 1024,
+    use_kernel: tp.Optional[bool] = None,
+) -> Array:
+    """Causal attention across the `axis_name` ring. Call inside shard_map.
+
+    Returns the local (B, H, Tl, C) output shard. Shards are assumed to be
+    contiguous sequence chunks in axis order (chunk g holds global positions
+    [g*Tl, (g+1)*Tl) — exactly what sharding the T axis of a (B, H, T, C)
+    array over `axis_name` produces)."""
+    out, _ = _ring_fwd(q, k, v, axis_name, block_size, use_kernel)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
+    if use_kernel is None:
+        use_kernel = _auto_use_kernel()
+    n = jax.lax.axis_size(axis_name)
+    g = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Diagonal pair: ordinary causal attention on the local shard (static
+    # case — ring step s=0 always visits the local shard).
+    out_d, lse_d = _pair_fwd(q, k, v, True, block_size, use_kernel)
+    if n == 1:
+        return out_d, (q, k, v, out_d, lse_d)
+
+    def ring_step(carry, s):
+        k_c, v_c, m, l, acc = carry
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        j = (g - s) % n  # global chunk index of the visiting K/V shard
+        # Off-diagonal pairs are never diagonal-straddling: j < g is fully
+        # valid (full attention, no mask), j > g contributes nothing — its
+        # lse is forced to MASK so its weight underflows to exactly 0 at
+        # merge (compute still runs: static shapes under scan).
+        o_s, lse_s = _pair_fwd(q, k_c, v_c, False, block_size, use_kernel)
+        lse_s = jnp.where(j < g, lse_s, MASK)
+        m_new = jnp.maximum(m, lse_s)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_s - m_new)
+        acc = acc * alpha[..., None] + o_s.astype(jnp.float32) * beta[..., None]
+        l = l * alpha + beta
+        return (k_c, v_c, m_new, l, acc), None
+
+    init = (k, v, lse_d, jnp.ones_like(lse_d), out_d.astype(jnp.float32))
+    (_, _, m, l, acc), _ = jax.lax.scan(ring_step, init, jnp.arange(1, n))
+    out = (acc / l[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, block_size, use_kernel, residuals, do):
+    if use_kernel is None:
+        use_kernel = _auto_use_kernel()
+    q, k, v, out, lse = residuals
+    n = jax.lax.axis_size(axis_name)
+    g = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Global softmax-jacobian correction, one pass (the kernels recompute it
+    # in-VMEM from the same o/do tiles; the jnp path takes it as input).
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+
+    dq, dk_c, dv_c = _pair_bwd(
+        q, k, v, out, do, lse, delta, True, block_size, use_kernel
+    )
+    if n == 1:
+        return dq.astype(q.dtype), dk_c.astype(k.dtype), dv_c.astype(v.dtype)
+
+    def ring_step(carry, s):
+        k_c, v_c, dk_c, dv_c, dq_acc = carry
+        # dK/dV accumulators ride the ring WITH their K/V shard: after the
+        # final rotation below they have made n hops and are home, carrying
+        # every device's contribution.
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        dk_c = jax.lax.ppermute(dk_c, axis_name, perm)
+        dv_c = jax.lax.ppermute(dv_c, axis_name, perm)
+        j = (g - s) % n
+        dq_s, dk_s, dv_s = _pair_bwd(
+            q, k_c, v_c, out, do, lse, delta, False, block_size, use_kernel
+        )
+        valid = j < g
+        dq_acc = dq_acc + jnp.where(valid, dq_s, 0.0)
+        dk_c = dk_c + jnp.where(valid, dk_s, 0.0)
+        dv_c = dv_c + jnp.where(valid, dv_s, 0.0)
+        return (k_c, v_c, dk_c, dv_c, dq_acc), None
+
+    (k_c, v_c, dk_c, dv_c, dq), _ = jax.lax.scan(
+        ring_step, (k, v, dk_c, dv_c, dq), jnp.arange(1, n)
+    )
+    dk = jax.lax.ppermute(dk_c, axis_name, perm)  # n-th hop: home
+    dv = jax.lax.ppermute(dv_c, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _ring_fwd_rule(q, k, v, axis_name, block_size, use_kernel):
+    return _ring_fwd(q, k, v, axis_name, block_size, use_kernel)
+
+
+ring_attention.defvjp(_ring_fwd_rule, _ring_bwd)
 
 
 def ring_attention_sharded(
@@ -140,12 +311,14 @@ def ring_attention_sharded(
     axis_name: str = "sp",
     batch_axes: tp.Tuple[str, ...] = ("data", "fsdp"),
     block_size: int = 1024,
+    use_kernel: tp.Optional[bool] = None,
 ) -> Array:
     """shard_map wrapper: shards T over `axis_name`, batch over `batch_axes`,
     runs the ring, returns the (B, H, T, C) result with the same layout."""
     spec = P(batch_axes, None, axis_name, None)
+    # nondiff_argnums of a custom_vjp function must be passed positionally
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, block_size=block_size),
+        lambda q, k, v: ring_attention(q, k, v, axis_name, block_size, use_kernel),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -155,3 +155,46 @@ def test_ring_kv_subblocking_parity(block_size):
     out = ring_attention_sharded(q, k, v, mesh, block_size=block_size)
     ref = naive_causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_kernel_path_forward_parity(sp):
+    """The Pallas-kernel per-pair path (the one a real TPU slice runs):
+    interpret mode on CPU, forced with use_kernel=True. The diagonal pair
+    uses the causal kernel, off-diagonal pairs the non-causal kernel."""
+    q, k, v = _qkv(T=128, C=32)
+    mesh = _mesh(sp)
+    out = ring_attention_sharded(q, k, v, mesh, use_kernel=True)
+    ref = naive_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_kernel_path_gradients(sp):
+    """Backward through the authored ring backward pass (custom VJP, flash
+    backward kernels per pair, dK/dV riding the ring) equals oracle AD."""
+    q, k, v = _qkv(B=2, H=2, T=128, C=32)
+    mesh = _mesh(sp)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jnp.sin(ring_attention_sharded(q, k, v, mesh, use_kernel=True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(naive_causal_attention(q, k, v)))
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gf), atol=3e-5, rtol=3e-5, err_msg=f"d{name}"
+        )
+
+
+def test_ring_kernel_jnp_paths_agree():
+    """Both per-pair implementations of the same ring schedule produce the
+    same result (kernel path in interpret mode vs blockwise jnp)."""
+    q, k, v = _qkv(B=2, H=2, T=256, C=16)
+    mesh = _mesh(4)
+    out_k = ring_attention_sharded(q, k, v, mesh, use_kernel=True)
+    out_j = ring_attention_sharded(q, k, v, mesh, use_kernel=False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_j), atol=2e-5, rtol=2e-5)

@@ -1,0 +1,94 @@
+"""Assemble an offline text corpus from files already on the machine.
+
+Air-gapped TPU hosts (zero egress — this environment) cannot download
+tiny-shakespeare or openwebtext, but they carry hundreds of MB of
+English-adjacent text: package documentation, READMEs, and source code.
+This tool walks a set of roots, concatenates every text-like file (sorted
+paths — deterministic), and writes one UTF-8 corpus file that
+`data/shakespeare_char/prepare.py --input` can tokenize.
+
+Not a replacement for a real web corpus — a way to exercise the full
+prepare → train → eval → sample pipeline at scale with genuinely
+non-random data when the canonical datasets are unreachable.
+
+Usage:
+    python tools/make_offline_corpus.py --out outputs/corpus.txt \
+        [--roots DIR ...] [--max-mb 400]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+TEXT_EXTS = (".py", ".md", ".rst", ".txt")
+SEP = "\n\n"
+
+
+def default_roots() -> list[str]:
+    roots = []
+    try:
+        import site
+
+        roots += site.getsitepackages()
+    except Exception:
+        pass
+    for r in ("/usr/share/doc",):
+        if os.path.isdir(r):
+            roots.append(r)
+    return roots
+
+
+def iter_files(roots: list[str]):
+    for root in roots:
+        for dirpath, dirs, files in os.walk(root):
+            dirs.sort()
+            for f in sorted(files):
+                if f.endswith(TEXT_EXTS):
+                    yield os.path.join(dirpath, f)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", type=str, required=True)
+    parser.add_argument("--roots", type=str, nargs="*", default=None)
+    parser.add_argument("--max-mb", type=float, default=400.0)
+    parser.add_argument(
+        "--max-file-kb", type=float, default=1024.0,
+        help="skip files bigger than this (generated/bundled blobs)",
+    )
+    args = parser.parse_args()
+
+    roots = args.roots or default_roots()
+    budget = int(args.max_mb * 1e6)
+    written = 0
+    n_files = 0
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as out:
+        for path in iter_files(roots):
+            if written >= budget:
+                break
+            try:
+                if os.path.getsize(path) > args.max_file_kb * 1024:
+                    continue
+                with open(path, "r", encoding="utf-8", errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            # Char-level models want a small vocab: bundled docs carry long
+            # tails of CJK/symbol codepoints that would explode it (and the
+            # uint16 token format caps vocab at 65536).
+            text = text.encode("ascii", errors="ignore").decode("ascii")
+            if not text.strip():
+                continue
+            out.write(text)
+            out.write(SEP)
+            written += len(text) + len(SEP)
+            n_files += 1
+    print(f"{args.out}: {written / 1e6:.1f} MB from {n_files} files ({len(roots)} roots)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
