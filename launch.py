@@ -33,14 +33,22 @@ def apply_overrides(config, pairs):
         for p in parts[:-1]:
             target = getattr(target, p)
         current = getattr(target, parts[-1])
-        ftype = type(current) if current is not None else str
-        if ftype is bool or raw_value.lower() in ("true", "false"):
+        # Optional fields default to None, so the current value's type can't
+        # drive parsing — consult the declared annotation (a string under
+        # `from __future__ import annotations`) so `--set loss_remat_chunks=0`
+        # parses as bool False, not the truthy string '0'.
+        fields = getattr(target, "__dataclass_fields__", {})
+        ann = str(fields[parts[-1]].type) if parts[-1] in fields else ""
+        if raw_value.lower() in ("none", "null"):
+            value = None  # tri-state fields (e.g. loss_remat_chunks)
+        elif isinstance(current, bool) or "bool" in ann:
             value = raw_value.lower() in ("1", "true", "yes")
-        elif raw_value.lower() in ("none", "null"):
-            # tri-state fields (e.g. loss_remat_chunks) default to None
-            value = None
+        elif raw_value.lower() in ("true", "false"):
+            value = raw_value.lower() == "true"
+        elif current is not None:
+            value = type(current)(raw_value)
         else:
-            value = ftype(raw_value)
+            value = raw_value
         node = tree
         for p in parts[:-1]:
             node = node.setdefault(p, {})

@@ -53,6 +53,10 @@ class ExperimentConfig:
     model_config: GPTConfig
     mesh: MeshConfig = MeshConfig()
     eval_steps: int = 200  # batches per eval (reference train.py:110)
+    # Max eval batches materialized on host / staged to device at once
+    # (training/train.py evaluate): bounds host memory to
+    # eval_host_chunk x local_batch x T int32 per split pass.
+    eval_host_chunk: int = 25
     log_interval: int = 20
     seed: int = 0
     data_seed: int = 1337  # seeded, resumable data sampler (reference has none)

@@ -33,15 +33,26 @@ def sample_batch(
     g_accum_iters: tp.Optional[int] = None,
     *,
     rng: tp.Optional[np.random.Generator] = None,
+    accum_slice: tp.Optional[tp.Tuple[int, int]] = None,
 ) -> tp.Tuple[np.ndarray, np.ndarray]:
     """Random (x, y=x shifted by one) windows, int32.
 
     Shapes: (B, T) or (G, B, T) when g_accum_iters is given (reference
     train.py:56-66).
-    """
+
+    accum_slice=(lo, m) materializes only accumulation steps [lo, lo+m) of
+    the full (g_accum_iters, B, T) draw: ALL window starts are generated (a
+    cheap rng.integers pass) and then sliced, so chunked consumers (the
+    memory-bounded evaluate loop) see bit-identical windows to a monolithic
+    caller."""
     rng = rng or np.random.default_rng()
     bs = batch_size * (g_accum_iters or 1)
     starts = rng.integers(0, len(data) - block_size, size=(bs,))
+    if accum_slice is not None:
+        assert g_accum_iters is not None
+        lo, m = accum_slice
+        starts = starts[lo * batch_size : (lo + m) * batch_size]
+        g_accum_iters = m
     # One-pass native gather when the C batcher is available (built on
     # demand, midgpt_tpu/native); numpy double-gather otherwise. The RNG
     # stays in numpy either way, so both paths are bit-identical.
@@ -101,11 +112,13 @@ class TokenDataset:
         block_size: int,
         batch_size: int,
         g_accum_iters: tp.Optional[int] = None,
+        accum_slice: tp.Optional[tp.Tuple[int, int]] = None,
     ) -> tp.Tuple[np.ndarray, np.ndarray]:
         """Deterministic batch for (split, step): resumable by construction."""
         rng = np.random.default_rng([self.seed, _SPLIT_IDS[split], step])
         return sample_batch(
-            self.splits[split], block_size, batch_size, g_accum_iters, rng=rng
+            self.splits[split], block_size, batch_size, g_accum_iters, rng=rng,
+            accum_slice=accum_slice,
         )
 
     def meta(self) -> tp.Optional[dict]:

@@ -263,6 +263,22 @@ class GPT:
         else:
             k_attn_drop = k_resid = k_mlp = None
 
+        with jax.named_scope("attn"):
+            att = GPT._attention(
+                config, params, x, sin, cos, positions, attn_fn,
+                k_attn_drop, inference,
+            )
+        with jax.named_scope("mlp"):
+            return GPT._attn_out_and_mlp(
+                config, params, x, att, k_resid=k_resid, k_mlp=k_mlp,
+                inference=inference,
+            )
+
+    @staticmethod
+    def _attention(
+        config, params, x, sin, cos, positions, attn_fn, k_attn_drop, inference
+    ) -> Array:
+        """QKV + RoPE + dispatched attention -> (B, T, H, C)."""
         h = rms_norm(x)  # weightless, eps 1e-6
         q, k, v = GPT._project_qkv(config, params, h)  # (B, T, H, C)
         q = apply_rope_bthc(q, sin, cos, positions)
@@ -317,9 +333,7 @@ class GPT:
                 layout="bthc",
             )
             att = checkpoint_name(att, "attn_out")
-        return GPT._attn_out_and_mlp(
-            config, params, x, att, k_resid=k_resid, k_mlp=k_mlp, inference=inference
-        )
+        return att
 
     @staticmethod
     def hidden(
@@ -331,8 +345,16 @@ class GPT:
         inference: bool = False,
         layer_transform: tp.Optional[tp.Callable[[BlockParams], BlockParams]] = None,
         attn_fn: tp.Optional[tp.Callable[[Array, Array, Array], Array]] = None,
+        positions: tp.Optional[Array] = None,
+        rope_len: tp.Optional[int] = None,
     ) -> Array:
         """Backbone forward -> final-normed hidden states (B, T, D).
+
+        `positions` (shape (T,), absolute) + `rope_len` (static table length
+        covering the largest position) let a sequence-parallel caller run the
+        backbone on a LOCAL sequence shard: tokens are pointwise in T except
+        attention (replaced via attn_fn) and RoPE, which these two arguments
+        make shard-aware (shard g passes positions g*Tl + arange(Tl)).
 
         `attn_fn` (optional) replaces the config-dispatched attention with a
         runtime-bound implementation — the sequence-parallel path passes the
@@ -358,22 +380,29 @@ class GPT:
         else:
             drop_key, layer_keys = None, None
 
-        x = jnp.take(params.wte, tokens, axis=0)  # (B, T, D)
-        x = dropout(x, config.dropout, drop_key, inference)
+        # jax.named_scope boundaries (embed / block / attn / mlp / final_norm)
+        # label the profiler trace like reference model.py:28,55,97,140 —
+        # tools/profile_summary.py groups exclusive op times by them.
+        with jax.named_scope("embed"):
+            x = jnp.take(params.wte, tokens, axis=0)  # (B, T, D)
+            x = dropout(x, config.dropout, drop_key, inference)
 
-        rope = rope_table(C, T)  # shared fp32 table, constant-folded under jit
+        # shared fp32 table, constant-folded under jit; rope_len covers the
+        # global sequence when T is a local shard of it
+        rope = rope_table(C, rope_len or T)
 
         def block_fn(x, block_and_key):
             block, k = block_and_key
             if layer_transform is not None:
                 block = layer_transform(block)
-            return (
-                GPT.block_apply(
-                    config, block, x, key=k, inference=inference, rope=rope,
-                    attn_fn=attn_fn,
-                ),
-                None,
-            )
+            with jax.named_scope("block"):
+                return (
+                    GPT.block_apply(
+                        config, block, x, key=k, inference=inference, rope=rope,
+                        positions=positions, attn_fn=attn_fn,
+                    ),
+                    None,
+                )
 
         if config.remat:
             block_fn = jax.checkpoint(block_fn, policy=_remat_policy(config.remat_policy))
@@ -381,7 +410,8 @@ class GPT:
             block_fn, x, (params.blocks, layer_keys), unroll=config.scan_unroll
         )
 
-        return rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
+        with jax.named_scope("final_norm"):
+            return rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
 
     @staticmethod
     def apply(

@@ -72,6 +72,16 @@ def _auto_use_kernel() -> bool:
     return jax.default_backend() == "tpu" or fa.RUN_INTERPRET_OFF_TPU
 
 
+def _kernel_serves(Tl: int, block_size: int) -> bool:
+    """True when the flash kernels tile this shard length cleanly. Shard
+    lengths the dispatcher's blocks don't divide (e.g. Tl=2560 at the
+    default 1024 KV block) fall back to the jnp pair path instead of
+    tripping _block_sizes' VMEM bound; the same predicate gates forward and
+    backward, so the custom VJP stays consistent."""
+    bq, bk = flash_block_sizes(Tl, block_size)
+    return Tl % bq == 0 and Tl % bk == 0
+
+
 def _divisor_block(Tl: int, block_size: int) -> int:
     blk = min(block_size, Tl)
     if Tl % blk:
@@ -115,11 +125,10 @@ def _pair_fwd_jnp(
     kb = k.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
     col0 = blk * jnp.arange(n_blk)
-    init = (
-        jnp.full((B, H, Tl), M_INIT, jnp.float32),
-        jnp.zeros((B, H, Tl), jnp.float32),
-        jnp.zeros((B, H, Tl, C), jnp.float32),
-    )
+    # init derived from q (not fresh constants) so the carry's device-varying
+    # axes match the body output under shard_map's vma tracking
+    zero_q = q.astype(jnp.float32) * 0
+    init = (zero_q[..., 0] + M_INIT, zero_q[..., 0], zero_q)
     (m, l, acc), _ = jax.lax.scan(kv_block_step, init, (kb, vb, col0))
     # every row has >= 1 valid key in both pair cases (diagonal: itself)
     out = (acc / l[..., None]).astype(q.dtype)
@@ -161,7 +170,7 @@ def _pair_bwd_jnp(
     vb = v.reshape(B, H, n_blk, blk, C).transpose(2, 0, 1, 3, 4)
     col0 = blk * jnp.arange(n_blk)
     dq, (dkb, dvb) = jax.lax.scan(
-        kv_block_step, jnp.zeros((B, H, Tl, C), jnp.float32), (kb, vb, col0)
+        kv_block_step, q.astype(jnp.float32) * 0, (kb, vb, col0)
     )
     dk = dkb.transpose(1, 2, 0, 3, 4).reshape(B, H, Tl, C)
     dv = dvb.transpose(1, 2, 0, 3, 4).reshape(B, H, Tl, C)
@@ -169,8 +178,8 @@ def _pair_bwd_jnp(
 
 
 def _pair_fwd(q, k, v, causal: bool, block_size: int, use_kernel: bool):
-    if use_kernel:
-        Tl = q.shape[2]
+    Tl = q.shape[2]
+    if use_kernel and _kernel_serves(Tl, block_size):
         bq, bk = flash_block_sizes(Tl, block_size)
         out, lse8 = fa._flash_forward(q, k, v, bq, bk, causal=causal)
         return out, lse8[..., 0]
@@ -178,8 +187,8 @@ def _pair_fwd(q, k, v, causal: bool, block_size: int, use_kernel: bool):
 
 
 def _pair_bwd(q, k, v, out, do, lse, delta, causal: bool, block_size: int, use_kernel: bool):
-    if use_kernel:
-        Tl = q.shape[2]
+    Tl = q.shape[2]
+    if use_kernel and _kernel_serves(Tl, block_size):
         bq, bk = flash_block_sizes(Tl, block_size)
         lse8 = jnp.broadcast_to(lse[..., None], (*lse.shape, fa._STATS_LANES))
         dq, dk, dv = fa._flash_backward(
@@ -244,7 +253,7 @@ def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
         l = l * alpha + beta
         return (k_c, v_c, m_new, l, acc), None
 
-    init = (k, v, lse_d, jnp.ones_like(lse_d), out_d.astype(jnp.float32))
+    init = (k, v, lse_d, lse_d * 0 + 1.0, out_d.astype(jnp.float32))
     (_, _, m, l, acc), _ = jax.lax.scan(ring_step, init, jnp.arange(1, n))
     out = (acc / l[..., None]).astype(q.dtype)
     lse = m + jnp.log(l)

@@ -34,6 +34,7 @@ from __future__ import annotations
 import typing as tp
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.models.gpt import GPT, GPTParams
@@ -65,27 +66,50 @@ def _drop_leading(spec: P) -> P:
 
 
 def make_shard_map_loss(
-    model_cfg, mesh: Mesh, param_specs, loss_chunk_tokens: int, loss_remat_chunks: bool = False
+    model_cfg,
+    mesh: Mesh,
+    param_specs,
+    loss_chunk_tokens: int,
+    loss_remat_chunks: tp.Optional[bool] = None,
+    sequence_parallel: bool = False,
 ) -> tp.Callable:
     """Build loss_fn(params, x, y, key) -> scalar with authored collectives.
 
     Drop-in replacement for the GSPMD loss in make_train_step: takes GLOBAL
     arrays, returns the global-mean loss; differentiable (grads come back in
-    the params' sharded layout)."""
+    the params' sharded layout).
+
+    With `sequence_parallel` the T axis of the batch is additionally sharded
+    over the mesh's 'sp' axis and attention runs the ring
+    (parallel/ring_attention.py) — the ZeRO-3 schedule and the ring compose
+    inside ONE shard_map body: per-layer weight all-gathers ride the 'fsdp'
+    axis while K/V shards rotate on 'sp', with no nesting. Everything else
+    in the backbone is token-pointwise, needing only shard-aware RoPE
+    positions (GPT.hidden positions/rope_len)."""
     block_specs = jax.tree.map(_drop_leading, param_specs.blocks)
 
     def gather_block(block):
         return jax.tree.map(_gather_leaf, block, block_specs)
 
+    loss_axes = BATCH_AXES + ("sp",) if sequence_parallel else BATCH_AXES
+
     def local_loss(params: GPTParams, x: Array, y: Array, key) -> Array:
         if key is not None:
-            # decorrelate dropout masks across batch shards
-            key = jax.random.fold_in(key, jax.lax.axis_index(BATCH_AXES))
+            # decorrelate dropout masks across batch (and sequence) shards
+            key = jax.random.fold_in(key, jax.lax.axis_index(loss_axes))
         full_wte = _gather_leaf(params.wte, param_specs.wte)
         full_head = _gather_leaf(params.lm_head, param_specs.lm_head)
         gathered = GPTParams(
             wte=full_wte, blocks=params.blocks, lm_head=full_head
         )
+        positions = rope_len = attn_fn = None
+        if sequence_parallel:
+            from midgpt_tpu.parallel.ring_attention import ring_attention
+
+            Tl = x.shape[1]
+            rope_len = Tl * jax.lax.axis_size("sp")
+            positions = jax.lax.axis_index("sp") * Tl + jnp.arange(Tl)
+            attn_fn = lambda q, k, v: ring_attention(q, k, v, "sp")
         h = GPT.hidden(
             model_cfg,
             gathered,
@@ -93,11 +117,16 @@ def make_shard_map_loss(
             key=key,
             inference=key is None,
             layer_transform=gather_block,
+            attn_fn=attn_fn,
+            positions=positions,
+            rope_len=rope_len,
         )
+        # local mean over an equal-size token shard -> pmean is the global
+        # mean (batch shards over data/fsdp, sequence shards over sp)
         loss = fused_linear_cross_entropy(h, full_head, y, loss_chunk_tokens, loss_remat_chunks)
-        return jax.lax.pmean(loss, BATCH_AXES)
+        return jax.lax.pmean(loss, loss_axes)
 
-    batch_spec = P(BATCH_AXES, None)
+    batch_spec = P(BATCH_AXES, "sp" if sequence_parallel else None)
     return jax.shard_map(
         local_loss,
         mesh=mesh,

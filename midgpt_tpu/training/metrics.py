@@ -132,6 +132,46 @@ class MetricLogger:
             self._wandb.finish()
 
 
+class Progress:
+    """Live single-line progress bar with a loss/lr/throughput postfix
+    (reference train.py:190-220 drives tqdm the same way). Process 0 only,
+    and only when stderr is a terminal — headless/nohup runs keep clean
+    line-per-interval logs from MetricLogger instead. Degrades to a no-op
+    when tqdm is unavailable."""
+
+    def __init__(self, total: int, first_step: int = 0, enabled: bool = True):
+        self._bar = None
+        if not enabled or jax.process_index() != 0:
+            return
+        try:
+            import sys
+
+            from tqdm import tqdm
+
+            if sys.stderr.isatty():
+                self._bar = tqdm(
+                    total=total, initial=first_step, dynamic_ncols=True,
+                    desc="train", unit="step",
+                )
+        except Exception:  # pragma: no cover - tqdm is optional
+            self._bar = None
+
+    @property
+    def active(self) -> bool:
+        return self._bar is not None
+
+    def update(self, n: int = 1, **postfix: tp.Any) -> None:
+        if self._bar is None:
+            return
+        if postfix:
+            self._bar.set_postfix(postfix, refresh=False)
+        self._bar.update(n)
+
+    def close(self) -> None:
+        if self._bar is not None:
+            self._bar.close()
+
+
 class Profiler:
     """One-shot trace of the first post-warmup step (reference train.py:205-211)."""
 

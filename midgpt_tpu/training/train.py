@@ -34,7 +34,7 @@ from midgpt_tpu.parallel.data import make_global_batch
 from midgpt_tpu.parallel.fsdp import constrain, fsdp_param_specs, named_shardings
 from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
 from midgpt_tpu.training.checkpoint import CheckpointManager
-from midgpt_tpu.training.metrics import MetricLogger, Profiler, mfu
+from midgpt_tpu.training.metrics import MetricLogger, Profiler, Progress, mfu
 from midgpt_tpu.training.optim import make_optimizer, make_schedule
 
 Array = jax.Array
@@ -52,16 +52,14 @@ def make_train_step(
     G = config.g_accum_iters
 
     # Sequence parallelism: ring attention is bound to the mesh here (the
-    # model is mesh-agnostic; attention is its only cross-token op).
+    # model is mesh-agnostic; attention is its only cross-token op). The
+    # GSPMD-sharded wrapper serves the implicit-FSDP train loss and all
+    # eval paths; the explicit shard_map path calls the ring directly
+    # inside its own body (no nesting — see make_shard_map_loss).
     attn_fn = None
     if model_cfg.attn_impl == "ring":
         from midgpt_tpu.parallel.ring_attention import ring_attention_sharded
 
-        if config.fsdp_mode == "shard_map":
-            raise NotImplementedError(
-                "attn_impl='ring' requires fsdp_mode='gspmd' (the explicit "
-                "shard_map FSDP path would nest shard_maps)"
-            )
         attn_fn = functools.partial(ring_attention_sharded, mesh=mesh)
 
     if config.fsdp_mode == "shard_map":
@@ -70,6 +68,7 @@ def make_train_step(
         _sm_loss = make_shard_map_loss(
             model_cfg, mesh, param_specs, config.loss_chunk_tokens,
             config.loss_remat_chunks,
+            sequence_parallel=model_cfg.attn_impl == "ring",
         )
 
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
@@ -135,10 +134,13 @@ def make_train_step(
 
     @jax.jit
     def eval_loss_many(params: GPTParams, x_NBT: Array, y_NBT: Array) -> Array:
-        """Mean loss over a stacked (N, B, T) eval set in ONE program: the
-        whole eval is a device-side scan with a single host sync, vs the
-        reference's 200 sequential jit calls + float() round-trips
-        (reference train.py:107-117)."""
+        """SUMMED loss over a stacked (N, B, T) eval set in one device-side
+        scan. Returning the sum (not the mean) lets `evaluate` chunk the
+        eval set to a fixed host-memory budget over the same windows, with
+        one division at the end (equal to the monolithic mean up to f32
+        re-association of the chunk subtotals). Still asynchronous — the
+        caller syncs once per eval, vs the reference's 200 sequential jit
+        calls + float() round-trips (reference train.py:107-117)."""
         params_c = cast_compute(params)
 
         def body(total, xy):
@@ -154,7 +156,7 @@ def make_train_step(
             )
 
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (x_NBT, y_NBT))
-        return total / x_NBT.shape[0]
+        return total
 
     return step, eval_loss, eval_loss_many
 
@@ -197,21 +199,36 @@ def evaluate(
     mesh,
     step_idx: int,
 ) -> float:
-    """Sample the whole eval set on host, run it as one device program."""
+    """Stream the eval set through fixed-size device programs, one sync.
+
+    Host memory is bounded to `eval_host_chunk` batches at a time (at
+    openwebtext_mh scale the whole 200-batch eval set is ~1.7 GB of int32
+    per host — an avoidable cliff). Each chunk is dispatched asynchronously
+    and only the final total is pulled to host, so the single-sync property
+    of the batched eval is preserved; the chunked result sums the same
+    windows (accum_slice) and differs from the monolithic one only by f32
+    re-association of chunk subtotals."""
     # leading N axis ~ the accum axis; sequence shards over 'sp' when on
     spec = batch_spec(with_accum=True, shard_seq=mesh.shape["sp"] > 1)
     n = 1 if config.debug else config.eval_steps
-    x, y = dataset.batch(
-        split,
-        # decorrelate eval batches from train batches and across evals
-        1_000_000_000 + step_idx,
-        config.model_config.block_size,
-        config.batch_size // jax.process_count(),
-        g_accum_iters=n,
-    )
-    xg = make_global_batch(x, mesh, spec)
-    yg = make_global_batch(y, mesh, spec)
-    return float(eval_loss_many(params, xg, yg))
+    chunk = max(1, min(n, config.eval_host_chunk))
+    total = None
+    for lo in range(0, n, chunk):
+        m = min(chunk, n - lo)
+        x, y = dataset.batch(
+            split,
+            # decorrelate eval batches from train batches and across evals
+            1_000_000_000 + step_idx,
+            config.model_config.block_size,
+            config.batch_size // jax.process_count(),
+            g_accum_iters=n,
+            accum_slice=(lo, m),
+        )
+        xg = make_global_batch(x, mesh, spec)
+        yg = make_global_batch(y, mesh, spec)
+        part = eval_loss_many(params, xg, yg)  # async device scalar (sum)
+        total = part if total is None else total + part
+    return float(total) / n
 
 
 def train(config: ExperimentConfig) -> dict:
@@ -249,6 +266,14 @@ def train(config: ExperimentConfig) -> dict:
 
     logger = MetricLogger(config)
     profiler = Profiler(config.rundir, enabled=config.debug)
+    progress = Progress(config.max_steps, first_step, enabled=not config.debug)
+    if os.environ.get("MIDGPT_VIZ_SHARDING") and jax.process_index() == 0:
+        # Startup sharding diagnostic (reference sample.py:181-182): how the
+        # largest weight and one batch land on the mesh.
+        try:
+            jax.debug.visualize_array_sharding(params.blocks.attn.wqkv[0])
+        except Exception as e:  # diagnostic only — never block training
+            print(f"visualize_array_sharding unavailable: {e}")
     data_sp = batch_spec(with_accum=True, shard_seq=mesh.shape["sp"] > 1)
     # Positional key stream: fold the step index into the base key so resumed
     # runs continue the exact dropout-key sequence (the data sampler is
@@ -295,14 +320,21 @@ def train(config: ExperimentConfig) -> dict:
             if m is not None:
                 metrics["throughput/mfu"] = m
             logger.log(itr, dict(metrics))
-            if jax.process_index() == 0:
+            if progress.active:
+                progress.update(
+                    0, loss=f"{loss_f:.4f}", lr=f"{metrics['lr']:.2e}",
+                    tok_s=f"{tok_s:,.0f}",
+                )
+            elif jax.process_index() == 0:
                 print(
                     f"step {itr}: loss {loss_f:.4f} lr {metrics['lr']:.2e} "
                     f"tok/s {tok_s:,.0f}"
                 )
+        progress.update(1)
         if mngr is not None:
             mngr.save(itr, {"params": params, "opt_state": opt_state})
 
+    progress.close()
     metrics["loss/final"] = float(
         evaluate(config, eval_loss_many, params, dataset, "val", mesh, config.max_steps)
     )

@@ -1,13 +1,27 @@
-"""Worker for the 2-process distributed CPU test (run via subprocess).
+"""Worker for the 2-process distributed CPU tests (run via subprocess).
 
 Each process: jax.distributed.initialize on localhost, 2 local CPU devices
 (4 global), per-process data shard via TokenDataset(shard_by_process=True),
-global batch assembly via make_global_batch, ONE compiled train step over a
-(data=2, fsdp=2) mesh. Prints `LOSS <value>` — the parent test asserts both
-processes print the same finite number (proving global-array assembly, not
-just single-process SPMD).
+global batch assembly via make_global_batch, compiled train steps over a
+(data=2, fsdp=2) mesh.
+
+Modes (argv[5], default "train"):
+  * train        — one step, print `LOSS <value>`: the parent asserts both
+                   processes print the same finite number (proving global
+                   array assembly, not just single-process SPMD).
+  * ckpt_save    — two steps, save a SHARDED checkpoint (each process writes
+                   its shards) to argv[6], then run step 2 and print
+                   `CONT <loss>` — the continued-training oracle.
+  * ckpt_restore — fresh processes RESTORE the sharded checkpoint from
+                   argv[6] (never recomputing steps 0-1), run step 2, print
+                   `CONT <loss>`. The parent asserts it matches the oracle:
+                   a failed or no-op restore would diverge, because restored
+                   params+opt state after 2 steps differ from a fresh init.
+    This beats the reference's pod-only checkpoint smoke (reference
+    scripts/test_ckpt.py:8-24, print-only) — it runs anywhere and asserts.
 
 Usage: python multiproc_worker.py <coordinator> <n_proc> <proc_id> <data_dir>
+           [mode] [rundir]
 """
 
 import sys
@@ -20,6 +34,8 @@ coordinator, n_proc, proc_id, data_dir = (
     int(sys.argv[3]),
     sys.argv[4],
 )
+mode = sys.argv[5] if len(sys.argv) > 5 else "train"
+rundir = sys.argv[6] if len(sys.argv) > 6 else ""
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
@@ -69,10 +85,43 @@ params, opt_state, specs, optimizer = init_state(config, mesh)
 step, *_ = make_train_step(config, optimizer, mesh, specs)
 
 local_bs = config.batch_size // n_proc
-x, y = dataset.batch("train", 0, config.model_config.block_size, local_bs, config.g_accum_iters)
-xg = make_global_batch(x, mesh, batch_spec())
-yg = make_global_batch(y, mesh, batch_spec())
-assert xg.shape == (config.g_accum_iters, config.batch_size, config.model_config.block_size)
+base_key = jax.random.PRNGKey(0)
 
-params, opt_state, loss = step(params, opt_state, xg, yg, jax.random.PRNGKey(0))
-print(f"LOSS {float(loss):.6f}", flush=True)
+
+def run_step(itr, params, opt_state):
+    x, y = dataset.batch(
+        "train", itr, config.model_config.block_size, local_bs, config.g_accum_iters
+    )
+    xg = make_global_batch(x, mesh, batch_spec())
+    yg = make_global_batch(y, mesh, batch_spec())
+    assert xg.shape == (
+        config.g_accum_iters, config.batch_size, config.model_config.block_size,
+    )
+    return step(params, opt_state, xg, yg, jax.random.fold_in(base_key, itr))
+
+
+if mode == "train":
+    params, opt_state, loss = run_step(0, params, opt_state)
+    print(f"LOSS {float(loss):.6f}", flush=True)
+elif mode == "ckpt_save":
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    for itr in (0, 1):
+        params, opt_state, loss = run_step(itr, params, opt_state)
+    mngr = CheckpointManager(rundir, max_to_keep=1, save_interval_steps=1)
+    mngr.save(1, {"params": params, "opt_state": opt_state}, force=True)
+    mngr.close()
+    params, opt_state, loss = run_step(2, params, opt_state)  # oracle
+    print(f"CONT {float(loss):.6f}", flush=True)
+elif mode == "ckpt_restore":
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(rundir, max_to_keep=1, save_interval_steps=1)
+    assert mngr.latest_step() == 1, mngr.latest_step()
+    state = mngr.restore(1, {"params": params, "opt_state": opt_state})
+    params, opt_state = state["params"], state["opt_state"]
+    mngr.close()
+    params, opt_state, loss = run_step(2, params, opt_state)
+    print(f"CONT {float(loss):.6f}", flush=True)
+else:
+    raise SystemExit(f"unknown mode {mode!r}")

@@ -42,3 +42,14 @@ def test_cross_field_validation_sees_final_state():
 def test_invalid_final_state_still_rejected():
     with pytest.raises(ValueError):
         launch_mod.apply_overrides(base, [("model_config.attn_impl", "flash")])
+
+
+def test_set_optional_bool_parses_numeric_and_none():
+    """loss_remat_chunks is Optional[bool] (None default): '--set
+    loss_remat_chunks=0' must become bool False (not the truthy string '0'),
+    and 'none' restores auto."""
+    ov = launch_mod.apply_overrides
+    assert ov(base, [("loss_remat_chunks", "0")]).loss_remat_chunks is False
+    assert ov(base, [("loss_remat_chunks", "1")]).loss_remat_chunks is True
+    assert ov(base, [("loss_remat_chunks", "false")]).loss_remat_chunks is False
+    assert ov(base, [("loss_remat_chunks", "none")]).loss_remat_chunks is None

@@ -198,3 +198,14 @@ def test_ring_kernel_jnp_paths_agree():
     out_k = ring_attention_sharded(q, k, v, mesh, use_kernel=True)
     out_j = ring_attention_sharded(q, k, v, mesh, use_kernel=False)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_j), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_kernel_auto_falls_back_on_unservable_shard():
+    """Shard lengths the kernel blocks don't divide (Tl=160 at block 64)
+    must fall back to the jnp pair path rather than erroring — parity holds
+    either way."""
+    q, k, v = _qkv(B=2, H=1, T=320, C=16)  # Tl=160 over sp=2; 160 % 64 != 0
+    mesh = _mesh(2)
+    out = ring_attention_sharded(q, k, v, mesh, block_size=64, use_kernel=True)
+    ref = naive_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

@@ -129,3 +129,26 @@ def test_mixed_precision_step_runs(data_dir):
         # master params stay fp32
         assert params.wte.dtype == jnp.float32
     assert bool(jnp.isfinite(loss))
+
+
+def test_evaluate_chunked_matches_monolithic(data_dir):
+    """Bounded-host-memory eval (eval_host_chunk) sums the same windows as a
+    single-program eval: same result up to f32 chunk-subtotal association."""
+    from midgpt_tpu.training.train import evaluate
+
+    cfg = tiny_config(data_dir, eval_steps=8, eval_host_chunk=3)
+    mesh = make_mesh(cfg.mesh)
+    params, opt_state, specs, optimizer = init_state(cfg, mesh)
+    _, _, eval_loss_many = make_train_step(cfg, optimizer, mesh, specs)
+
+    ds = TokenDataset(str(data_dir), seed=cfg.data_seed)
+    chunked = evaluate(cfg, eval_loss_many, params, ds, "val", mesh, 0)
+    mono = evaluate(
+        cfg.replace(eval_host_chunk=1000), eval_loss_many, params, ds, "val", mesh, 0
+    )
+    np.testing.assert_allclose(chunked, mono, rtol=1e-6)
+
+    # accum_slice windows == the corresponding slice of the monolithic draw
+    xa, _ = ds.batch("val", 5, 16, 4, g_accum_iters=8)
+    xs, _ = ds.batch("val", 5, 16, 4, g_accum_iters=8, accum_slice=(2, 3))
+    np.testing.assert_array_equal(xa[2:5], xs)
