@@ -1,0 +1,154 @@
+"""Fully-offline BPE data prep: local text trees → trained byte-level BPE → uint16 bins.
+
+The openwebtext recipe (data/openwebtext/prepare.py) needs HF hub + tiktoken
+egress. Air-gapped TPU pods often have neither, but they do have large local
+text trees (source checkouts, docs, mounted corpora). This pipeline produces
+a training-ready dataset with the SAME on-disk contract (`train.bin`/`val.bin`
+flat uint16 + `meta.pkl`) from purely local files:
+
+  1. walk --roots collecting text files by extension, dedup by content hash
+  2. train a byte-level BPE (HF `tokenizers`, Rust) of --vocab-size on the
+     corpus itself — no downloaded vocab needed
+  3. encode every document, append <|endoftext|> (id matching the trained
+     vocab), stream the ids into uint16 bins with the reference's 0.05% val
+     split discipline (shuffle seed 2357; reference data/openwebtext/
+     prepare.py:21-30 uses the same fraction/seed on HF splits)
+
+`meta.pkl` records {"kind": "hf_bpe", "tokenizer_file", "vocab_size"} so
+sample.py round-trips text through the trained tokenizer.
+
+Usage:
+    python data/local_text/prepare.py --roots DIR [DIR ...] [--vocab-size N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import pickle
+import sys
+
+import numpy as np
+
+try:
+    from tokenizers import ByteLevelBPETokenizer, Tokenizer
+except ImportError:
+    sys.exit("pip install tokenizers  (ships with transformers)")
+
+VAL_FRACTION = 0.0005
+SPLIT_SEED = 2357  # same split discipline as the openwebtext recipe
+EOT = "<|endoftext|>"
+
+
+def collect_documents(roots, exts, max_bytes, min_bytes=256):
+    """Unique (by content hash) utf-8 decodable files under roots."""
+    seen, docs = set(), []
+    for root in roots:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                if not any(fn.endswith(e) for e in exts):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    size = os.path.getsize(path)
+                    if not (min_bytes <= size <= max_bytes):
+                        continue
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                except OSError:
+                    continue
+                digest = hashlib.sha1(raw).digest()
+                if digest in seen:
+                    continue
+                seen.add(digest)
+                try:
+                    text = raw.decode("utf-8")
+                except UnicodeDecodeError:
+                    continue
+                docs.append(text)
+    return docs
+
+
+def encode_to_bin(tokenizer, docs, eot_id, path, batch=512):
+    """Stream-encode docs into a flat uint16 file; returns token count."""
+    total = 0
+    with open(path, "wb") as f:
+        for lo in range(0, len(docs), batch):
+            encs = tokenizer.encode_batch(docs[lo : lo + batch])
+            for e in encs:
+                ids = np.asarray(e.ids + [eot_id], dtype=np.uint16)
+                ids.tofile(f)
+                total += ids.size
+    return total
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--roots", nargs="+", required=True)
+    parser.add_argument("--out-dir", default=os.path.dirname(os.path.abspath(__file__)))
+    parser.add_argument("--vocab-size", type=int, default=50257)
+    parser.add_argument("--exts", default=".py,.md,.rst,.txt")
+    parser.add_argument("--max-file-mb", type=float, default=2.0)
+    parser.add_argument("--val-fraction", type=float, default=VAL_FRACTION)
+    parser.add_argument(
+        "--train-sample-mb", type=float, default=0.0,
+        help="cap BPE *training* to a seeded random sample of this many MB "
+        "of text (0 = train on everything). Encoding always covers the full "
+        "corpus — merges learned from a large sample are near-identical, and "
+        "BPE training is the single-core-hostile part of the pipeline.",
+    )
+    args = parser.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    exts = tuple(args.exts.split(","))
+
+    docs = collect_documents(args.roots, exts, int(args.max_file_mb * 1e6))
+    n_chars = sum(len(d) for d in docs)
+    print(f"collected {len(docs):,} unique documents, {n_chars:,} chars")
+    if not docs:
+        sys.exit("no documents found under the given roots")
+
+    rng = np.random.default_rng(SPLIT_SEED)
+    order = rng.permutation(len(docs))
+    n_val = max(1, int(len(docs) * args.val_fraction))
+    val_docs = [docs[i] for i in order[:n_val]]
+    train_docs = [docs[i] for i in order[n_val:]]
+
+    trainer_docs = docs
+    if args.train_sample_mb > 0:
+        budget = int(args.train_sample_mb * 1e6)
+        sample_order = np.random.default_rng(SPLIT_SEED + 1).permutation(len(docs))
+        trainer_docs, used = [], 0
+        for i in sample_order:
+            trainer_docs.append(docs[i])
+            used += len(docs[i])
+            if used >= budget:
+                break
+        print(f"BPE trainer sample: {len(trainer_docs):,} docs, {used:,} chars")
+
+    tok_path = os.path.join(args.out_dir, "tokenizer.json")
+    tokenizer = ByteLevelBPETokenizer()
+    tokenizer.train_from_iterator(
+        iter(trainer_docs), vocab_size=args.vocab_size, special_tokens=[EOT],
+        show_progress=False,
+    )
+    tokenizer.save(tok_path)
+    tokenizer = Tokenizer.from_file(tok_path)
+    eot_id = tokenizer.token_to_id(EOT)
+    vocab_size = tokenizer.get_vocab_size()
+    assert vocab_size <= np.iinfo(np.uint16).max, "uint16 stream format"
+    print(f"trained BPE: vocab {vocab_size}, eot id {eot_id} -> {tok_path}")
+
+    for name, split in (("train", train_docs), ("val", val_docs)):
+        path = os.path.join(args.out_dir, f"{name}.bin")
+        total = encode_to_bin(tokenizer, split, eot_id, path)
+        print(f"{name}: {total:,} tokens -> {path}")
+
+    with open(os.path.join(args.out_dir, "meta.pkl"), "wb") as f:
+        pickle.dump(
+            {"kind": "hf_bpe", "tokenizer_file": "tokenizer.json", "vocab_size": vocab_size}, f
+        )
+
+
+if __name__ == "__main__":
+    main()

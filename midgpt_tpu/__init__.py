@@ -4,7 +4,7 @@ A from-scratch JAX/XLA/Pallas framework with the capabilities of the reference
 midGPT harness (see SURVEY.md): decoder-only GPT pretraining with rotary
 embeddings, weightless RMSNorm, QK-LayerNorm, independent weight decay, bf16
 compute over fp32 master params, gradient accumulation, FSDP sharding over a
-2D (data, fsdp) TPU mesh, async Orbax checkpointing, and KV-cached sampling.
+named TPU mesh, async Orbax checkpointing, and KV-cached sampling.
 
 TPU-first design notes:
   * The model is a plain pytree of arrays (no module framework): transformer
@@ -12,7 +12,7 @@ TPU-first design notes:
     single `jax.lax.scan` with per-block `jax.checkpoint` — one fused XLA
     program, compile time independent of depth.
   * Parallelism is expressed as `jax.sharding` PartitionSpecs over a named
-    mesh ('data', 'fsdp', 'sp'); XLA GSPMD inserts all ICI collectives.
+    mesh ('data', 'fsdp', 'sp', 'tp'); XLA GSPMD inserts all ICI collectives.
   * The attention hot op dispatches over implementations (naive T×T,
     blockwise O(T) online-softmax; Pallas flash kernel and ring-attention
     context parallelism land here as they are built).

@@ -19,18 +19,21 @@ from midgpt_tpu.models.gpt import GPTConfig
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Logical 3D device mesh. Axis sizes of -1 are inferred at runtime.
+    """Logical 4D device mesh. Axis sizes of -1 are inferred at runtime.
 
     The reference hard-codes Mesh((n_devices // 8, 8), ('replica', 'data'))
     (reference train.py:130) — i.e. batch over both axes, params over the
     8-wide axis. Here the axes are named for their role: batch shards over
-    ('data', 'fsdp'), params over 'fsdp', and the sequence axis over 'sp'
-    (context parallelism; 1 unless ring attention is on).
+    ('data', 'fsdp'), params over 'fsdp', the sequence axis over 'sp'
+    (context parallelism; 1 unless ring attention is on), and the block
+    projections' feature axes over 'tp' (Megatron tensor parallelism,
+    parallel/tp.py; 1 unless enabled).
     """
 
-    data: int = -1  # -1: infer as n_devices // (fsdp * sp)
+    data: int = -1  # -1: infer as n_devices // (fsdp * sp * tp)
     fsdp: int = 8
     sp: int = 1
+    tp: int = 1  # tensor parallelism (Megatron column/row, parallel/tp.py)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +89,22 @@ class ExperimentConfig:
                 f"dropout (dropout={mc.dropout}); use attn_impl='naive' or "
                 "set dropout=0.0"
             )
+        tp = self.mesh.tp
+        if tp == -1:
+            tp = 1  # the documented "infer at runtime" sentinel (make_mesh)
+        if tp < 1:
+            raise ValueError(f"mesh.tp={tp} must be >= 1 (or -1 to infer)")
+        if tp > 1:
+            # Megatron sharding needs whole heads / whole MLP columns per
+            # tp shard, and composes only with the GSPMD schedule for now.
+            if mc.n_head % tp != 0:
+                raise ValueError(f"n_head={mc.n_head} not divisible by mesh.tp={tp}")
+            if (4 * mc.n_embd) % tp != 0:
+                raise ValueError(f"4*n_embd={4 * mc.n_embd} not divisible by mesh.tp={tp}")
+            if self.fsdp_mode != "gspmd":
+                raise ValueError("mesh.tp > 1 requires fsdp_mode='gspmd'")
+            if mc.attn_impl == "ring":
+                raise ValueError("mesh.tp > 1 does not compose with attn_impl='ring' yet")
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

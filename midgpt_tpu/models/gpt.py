@@ -85,7 +85,17 @@ class GPTConfig:
 
 @pytree_dataclass
 class AttentionParams:
-    wqkv: Array  # (3D, D) fused QKV projection, applied as W @ x
+    # (3D, D) fused QKV projection, applied as W @ x. Output rows are
+    # HEAD-MAJOR interleaved — H blocks of (q_h, k_h, v_h), each (3C, D) —
+    # not the stacked [q; k; v]: the unpack is then a free reshape to
+    # (B, T, H, 3, C), and sharding the 3D axis over the mesh 'tp' axis
+    # (parallel/tp.py) puts WHOLE heads on each shard (boundaries at
+    # (H/tp)*3C align with head groups), which is what makes Megatron TP
+    # collective-free between the column- and row-parallel matmuls. The
+    # reference's stacked-qkv split (reference model.py:63-66) is a row
+    # permutation of this; init rows are iid so the distribution is
+    # identical.
+    wqkv: Array
     wo: Array  # (D, D) output projection
     q_scale: Array  # (C,) QK-LayerNorm scale for queries
     k_scale: Array  # (C,) QK-LayerNorm scale for keys
@@ -209,14 +219,17 @@ class GPT:
 
         Sequence-major (B, T, H, C) is the layout the fused projection
         produces with a plain reshape; the flash kernel consumes it natively,
-        so the training hot path never materializes a head transpose."""
+        so the training hot path never materializes a head transpose. The
+        head-major interleaved wqkv layout (see AttentionParams) makes the
+        unpack a reshape + unstack along a replicated axis — under tensor
+        parallelism the H axis arrives already sharded, no resharding."""
         B, T, D = h.shape
         H, C = config.n_head, config.head_dim
         qkv = jnp.einsum("btd,ed->bte", h, block.attn.wqkv)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = head_layer_norm(q.reshape(B, T, H, C), block.attn.q_scale)
-        k = head_layer_norm(k.reshape(B, T, H, C), block.attn.k_scale)
-        v = v.reshape(B, T, H, C)
+        qkv = qkv.reshape(B, T, H, 3, C)
+        q = head_layer_norm(qkv[..., 0, :], block.attn.q_scale)
+        k = head_layer_norm(qkv[..., 1, :], block.attn.k_scale)
+        v = qkv[..., 2, :]
         return q, k, v
 
     @staticmethod

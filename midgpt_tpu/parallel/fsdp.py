@@ -1,4 +1,4 @@
-"""FSDP parameter-sharding rules over the ('data', 'fsdp', 'sp') mesh.
+"""FSDP parameter-sharding rules over the ('data', 'fsdp', 'sp', 'tp') mesh.
 
 Rule (generalizing reference model.py:167-178): every array leaf with
 size > min_size is sharded along one axis over mesh axis 'fsdp'; everything

@@ -1,12 +1,13 @@
-"""Device mesh construction: a named 3D ('data', 'fsdp', 'sp') logical mesh.
+"""Device mesh construction: a named 4D ('data', 'fsdp', 'sp', 'tp') mesh.
 
 The reference hard-codes Mesh((n_devices // 8, 8), ('replica', 'data')) —
 batch over both axes, params over the 8-wide axis (reference train.py:130),
 which requires device counts divisible by 8. Here axis sizes come from config
 with -1 inference, `mesh_utils.create_device_mesh` picks the physical layout
 so 'fsdp' collectives (the per-layer all-gathers/reduce-scatters) ride
-contiguous ICI links, and 'sp' is the context-parallel axis for ring
-attention (size 1 unless long-context is on).
+contiguous ICI links, 'sp' is the context-parallel axis for ring attention,
+and 'tp' is the tensor-parallel axis (Megatron column/row sharding of the
+block projections, parallel/tp.py) — both size 1 unless enabled.
 """
 
 from __future__ import annotations
@@ -20,7 +21,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.config import MeshConfig
 
-AXES = ("data", "fsdp", "sp")
+AXES = ("data", "fsdp", "sp", "tp")
 
 
 def make_mesh(
@@ -33,16 +34,20 @@ def make_mesh(
     n = len(devices)
     fsdp = cfg.fsdp if cfg.fsdp != -1 else 1
     sp = cfg.sp if cfg.sp != -1 else 1
-    if n % (fsdp * sp) != 0:
+    tp_ = cfg.tp if cfg.tp != -1 else 1
+    if n % (fsdp * sp * tp_) != 0:
         # Degrade gracefully on small device counts (e.g. 1-chip dev boxes):
-        # clamp fsdp to the largest divisor of n // sp.
-        if n % sp != 0:
-            raise ValueError(f"{n} devices not divisible by sp={sp}")
-        fsdp = max(d for d in range(1, n // sp + 1) if (n // sp) % d == 0 and d <= fsdp)
-    data = cfg.data if cfg.data != -1 else n // (fsdp * sp)
-    if data * fsdp * sp != n:
-        raise ValueError(f"mesh {data}x{fsdp}x{sp} != {n} devices")
-    mesh_devices = mesh_utils.create_device_mesh((data, fsdp, sp), devices=np.asarray(devices))
+        # clamp fsdp to the largest divisor of n // (sp * tp).
+        if n % (sp * tp_) != 0:
+            raise ValueError(f"{n} devices not divisible by sp={sp} * tp={tp_}")
+        rest = n // (sp * tp_)
+        fsdp = max(d for d in range(1, rest + 1) if rest % d == 0 and d <= fsdp)
+    data = cfg.data if cfg.data != -1 else n // (fsdp * sp * tp_)
+    if data * fsdp * sp * tp_ != n:
+        raise ValueError(f"mesh {data}x{fsdp}x{sp}x{tp_} != {n} devices")
+    mesh_devices = mesh_utils.create_device_mesh(
+        (data, fsdp, sp, tp_), devices=np.asarray(devices)
+    )
     return Mesh(mesh_devices, axis_names=AXES)
 
 

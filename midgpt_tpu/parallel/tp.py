@@ -1,0 +1,94 @@
+"""Tensor parallelism: Megatron column/row sharding of the block projections.
+
+Beyond the reference's capability set (its only model sharding is FSDP,
+reference model.py:167-178); added for model families too big for FSDP-only.
+The GPT block has exactly four projections, and the classic Megatron-LM
+schedule falls out of sharding them over the mesh 'tp' axis:
+
+  column-parallel (shard the OUTPUT features):
+    wqkv  (L, 3D, D) -> P(None, 'tp', 'fsdp')   whole heads per shard — the
+        stacked axis is head-major interleaved (H blocks of (q,k,v), see
+        models/gpt.py AttentionParams), so shard boundaries at (H/tp)*3C
+        fall between head groups, never inside q/k/v
+    w_up  (L, 4D, D) -> P(None, 'tp', 'fsdp')   whole MLP columns per shard
+  row-parallel (shard the INPUT / contraction features):
+    wo     (L, D, D)  -> P(None, 'fsdp', 'tp')
+    w_down (L, D, 4D) -> P(None, 'fsdp', 'tp')
+
+Everything between a column-parallel and its matching row-parallel matmul
+(QK-norm, RoPE, attention itself, the GELU) is pointwise in the sharded
+feature/head axis, so GSPMD propagates the shard through with zero
+collectives; the row-parallel contraction produces partial sums and the
+residual-add's replicated requirement makes XLA place exactly the one
+all-reduce per half-block that Megatron prescribes. The embedding/lm_head
+stay on the FSDP rule (vocab-parallel CE is a separate schedule).
+
+FSDP composes on the leaf's OTHER feature axis: each tp shard's weights are
+further sharded/gathered over 'fsdp', i.e. standard 2D (tp × zero-3) layout.
+
+Specs are path-keyed on the leaf field names (wqkv/wo/w_up/w_down), so the
+same rule covers params AND optimizer state (mu/nu mirror the param tree).
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from midgpt_tpu.parallel.fsdp import fsdp_param_specs
+
+# leaf field name -> axis (from the end) that shards over 'tp'
+_COLUMN_PARALLEL = {"wqkv": 2, "w_up": 2}  # output features = axis -2
+_ROW_PARALLEL = {"wo": 1, "w_down": 1}  # input features = axis -1
+
+
+def _leaf_name(path: tp.Tuple[tp.Any, ...]) -> str:
+    """Last attribute-ish component of a pytree path."""
+    for entry in reversed(path):
+        name = getattr(entry, "name", None) or getattr(entry, "key", None)
+        if isinstance(name, str):
+            return name
+    return ""
+
+
+def tp_param_specs(
+    params: tp.Any,
+    mesh: Mesh,
+    shard_model: bool = True,
+    min_size: int = 2**18,
+) -> tp.Any:
+    """Pytree of PartitionSpecs: Megatron 'tp' on the four block projections
+    (composed with 'fsdp' on their other feature axis), the plain FSDP rule
+    (parallel/fsdp.py) everywhere else. With mesh tp=1 this IS the FSDP rule."""
+    n_tp = mesh.shape["tp"]
+    n_fsdp = mesh.shape["fsdp"]
+    base = fsdp_param_specs(params, mesh, shard_model, min_size)
+    if n_tp == 1:
+        return base
+
+    def rule(path, x, base_spec):
+        name = _leaf_name(path)
+        if name in _COLUMN_PARALLEL:
+            tp_ax = x.ndim - _COLUMN_PARALLEL[name]
+        elif name in _ROW_PARALLEL:
+            tp_ax = x.ndim - _ROW_PARALLEL[name]
+        else:
+            return base_spec
+        if x.ndim < 2 or x.shape[tp_ax] % n_tp != 0:
+            return base_spec
+        # fsdp composes on the other trailing (feature) axis
+        fsdp_ax = x.ndim - 1 if tp_ax == x.ndim - 2 else x.ndim - 2
+        spec: tp.List[tp.Any] = [None] * x.ndim
+        spec[tp_ax] = "tp"
+        if (
+            shard_model
+            and n_fsdp > 1
+            and x.size > min_size
+            and x.shape[fsdp_ax] % n_fsdp == 0
+        ):
+            spec[fsdp_ax] = "fsdp"
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(rule, params, base)

@@ -16,8 +16,8 @@ optimizer chain just to get a skeleton):
 Works on local paths and gs:// rundirs alike (TensorStore handles both).
 
 Layout note: checkpoints are saved as named Composite items ("params",
-"opt_state"); this is the framework's only supported layout — there is no
-reader for other orbax layouts.
+"opt_state") plus a "format" JSON marker; this is the framework's only
+supported layout — there is no reader for other orbax layouts.
 """
 
 from __future__ import annotations
@@ -26,6 +26,14 @@ import typing as tp
 
 import jax
 import orbax.checkpoint as ocp
+
+# Format marker saved alongside the state and verified at restore. Version
+# history:
+#   2 — wqkv rows are head-major interleaved (models/gpt.py AttentionParams);
+#       version-1 checkpoints (stacked [q;k;v]) would restore without any
+#       shape error but every head would read other heads' projection rows,
+#       so restore REFUSES checkpoints without a matching marker.
+FORMAT = {"version": 2, "qkv_layout": "head_major"}
 
 
 def _abstract_like(tree: tp.Any) -> tp.Any:
@@ -72,7 +80,8 @@ class CheckpointManager:
         the manager filters by save_interval_steps unless `force` (used for the
         final step of a run)."""
         args = ocp.args.Composite(
-            **{name: ocp.args.StandardSave(item) for name, item in state.items()}
+            format=ocp.args.JsonSave(FORMAT),
+            **{name: ocp.args.StandardSave(item) for name, item in state.items()},
         )
         return self._mngr.save(step, args=args, force=force)
 
@@ -81,6 +90,25 @@ class CheckpointManager:
         abstract trees). Restoring a SUBSET of the saved items is supported —
         the sampler restores only {"params": ...} without touching the
         optimizer state."""
+        # Validate the format marker FIRST, on its own, so a marker problem
+        # (pre-v2 checkpoint, foreign layout) is diagnosed as such and a
+        # genuine state-restore failure (e.g. shape mismatch) isn't.
+        try:
+            fmt = self._mngr.restore(
+                step, args=ocp.args.Composite(format=ocp.args.JsonRestore())
+            )["format"]
+        except (FileNotFoundError, KeyError, ValueError) as e:
+            raise ValueError(
+                f"checkpoint step {step} has no readable 'format' marker — it "
+                f"predates checkpoint format v{FORMAT['version']} (or is not "
+                "this framework's layout) and would restore silently wrong "
+                f"(see training/checkpoint.py FORMAT). Underlying error: {e}"
+            ) from e
+        if fmt != FORMAT:
+            raise ValueError(
+                f"checkpoint format mismatch: saved {fmt}, this build reads "
+                f"{FORMAT} — refusing a silently-wrong restore"
+            )
         args = ocp.args.Composite(
             **{
                 name: ocp.args.StandardRestore(_abstract_like(item))

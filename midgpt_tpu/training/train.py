@@ -31,7 +31,7 @@ from midgpt_tpu.data.dataset import TokenDataset
 from midgpt_tpu.models.gpt import GPT, GPTParams
 from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 from midgpt_tpu.parallel.data import make_global_batch
-from midgpt_tpu.parallel.fsdp import constrain, fsdp_param_specs, named_shardings
+from midgpt_tpu.parallel.fsdp import constrain, named_shardings
 from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
 from midgpt_tpu.training.checkpoint import CheckpointManager
 from midgpt_tpu.training.metrics import MetricLogger, Profiler, Progress, mfu
@@ -169,7 +169,11 @@ def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp
     abstract_params = jax.eval_shape(
         lambda k: GPT.init(config.model_config, k), jax.random.PRNGKey(0)
     )
-    param_specs = fsdp_param_specs(
+    # Spec rule: Megatron tp x fsdp (parallel/tp.py) — with mesh tp=1 it
+    # reduces to the plain FSDP rule exactly (pinned by test_tp.py).
+    from midgpt_tpu.parallel.tp import tp_param_specs as spec_rule
+
+    param_specs = spec_rule(
         abstract_params, mesh, config.shard_model, config.fsdp_min_size
     )
 
@@ -181,7 +185,7 @@ def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp
     params = jax.jit(init_fn)(jax.random.PRNGKey(config.seed))
 
     abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
-    opt_specs = fsdp_param_specs(
+    opt_specs = spec_rule(
         abstract_opt, mesh, config.shard_model, config.fsdp_min_size
     )
     opt_state = jax.jit(

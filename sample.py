@@ -68,15 +68,23 @@ def main() -> None:
     print(f"restored checkpoint step {step}")
     params = cast_floating(params, jnp.dtype(config.compute_dtype))
 
-    # Tokenizer: char codec if the dataset ships one, else GPT-2 BPE
+    # Tokenizer: dataset-shipped codec if present (char stoi/itos, or an
+    # offline-trained HF BPE from data/local_text/prepare.py), else GPT-2 BPE
     # (reference sample.py:143-159).
     meta_path = os.path.join(config.data_dir, "meta.pkl")
     if os.path.exists(meta_path):
         with open(meta_path, "rb") as f:
             meta = pickle.load(f)
-        stoi, itos = meta["stoi"], meta["itos"]
-        encode = lambda s: [stoi[c] for c in s]
-        decode = lambda ids: "".join(itos[i] for i in ids)
+        if meta.get("kind") == "hf_bpe":
+            from tokenizers import Tokenizer
+
+            tok = Tokenizer.from_file(os.path.join(config.data_dir, meta["tokenizer_file"]))
+            encode = lambda s: tok.encode(s).ids
+            decode = lambda ids: tok.decode(ids, skip_special_tokens=False)
+        else:
+            stoi, itos = meta["stoi"], meta["itos"]
+            encode = lambda s: [stoi[c] for c in s]
+            decode = lambda ids: "".join(itos[i] for i in ids)
     else:
         import tiktoken
 

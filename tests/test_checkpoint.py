@@ -89,3 +89,30 @@ def test_save_interval_filtering_and_force(tmp_path):
     mngr.wait()
     assert mngr.latest_step() == 13
     mngr.close()
+
+
+def test_format_marker_rejects_mismatched_checkpoint(tmp_path, monkeypatch):
+    """A checkpoint whose format marker doesn't match this build (e.g. the
+    pre-v2 stacked-qkv layout) must refuse to restore rather than silently
+    reinterpret the arrays (training/checkpoint.py FORMAT)."""
+    import pytest
+
+    from midgpt_tpu.training import checkpoint as ckpt_mod
+
+    config = make_config(MeshConfig(data=1, fsdp=1, sp=1))
+    mesh = make_mesh(config.mesh, devices=jax.devices()[:1])
+    params, opt_state, _, _ = init_state(config, mesh)
+    mngr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=1)
+    mngr.save(0, {"params": params})
+    mngr.wait()
+    mngr.close()
+
+    # A build with a different format must refuse this checkpoint.
+    monkeypatch.setattr(
+        ckpt_mod, "FORMAT", {"version": 99, "qkv_layout": "other"}
+    )
+    mngr2 = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=1)
+    abstract = jax.eval_shape(lambda k: GPT.init(CFG, k), jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="format"):
+        mngr2.restore(0, {"params": abstract})
+    mngr2.close()

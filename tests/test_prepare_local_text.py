@@ -1,0 +1,75 @@
+"""Unit tests for the fully-offline BPE prep pipeline (data/local_text).
+
+Runs the real HF `tokenizers` trainer on a tiny corpus — everything here is
+offline by construction, which is the pipeline's point.
+"""
+
+import importlib.util
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("tokenizers")
+
+_spec = importlib.util.spec_from_file_location(
+    "prepare_local_text",
+    os.path.join(os.path.dirname(__file__), "..", "data", "local_text", "prepare.py"),
+)
+prep = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(prep)
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    (d / "a.py").write_text("def add(a, b):\n    return a + b\n" * 40)
+    (d / "b.md").write_text("# Title\n\nSome prose about the add function.\n" * 40)
+    (d / "dup.py").write_text("def add(a, b):\n    return a + b\n" * 40)  # exact dup of a.py
+    (d / "small.txt").write_text("x")  # under min_bytes -> skipped
+    (d / "bin.txt").write_bytes(b"\xff\xfe" + os.urandom(600))  # not utf-8 -> skipped
+    (d / "skip.cfg").write_text("not a collected extension\n" * 40)
+    return d
+
+
+def test_collect_documents_dedup_and_filters(corpus):
+    docs = prep.collect_documents([str(corpus)], (".py", ".md", ".txt"), 10**6)
+    assert len(docs) == 2  # a.py (dup collapsed), b.md
+    assert any("def add" in d for d in docs)
+
+
+def test_end_to_end_pipeline_round_trip(corpus, tmp_path):
+    out = tmp_path / "out"
+    res = subprocess.run(
+        [
+            sys.executable, prep.__file__,
+            "--roots", str(corpus),
+            "--out-dir", str(out),
+            "--vocab-size", "400",
+            "--val-fraction", "0.5",
+        ],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stderr
+    for name in ("train.bin", "val.bin", "tokenizer.json", "meta.pkl"):
+        assert (out / name).exists()
+    with open(out / "meta.pkl", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["kind"] == "hf_bpe"
+    assert meta["vocab_size"] <= 400
+
+    from tokenizers import Tokenizer
+
+    tok = Tokenizer.from_file(str(out / "tokenizer.json"))
+    eot = tok.token_to_id(prep.EOT)
+    train = np.fromfile(out / "train.bin", dtype=np.uint16)
+    assert train.size > 0
+    assert train.max() < meta["vocab_size"]
+    assert train[-1] == eot  # every document ends in the sentinel
+    # tokens decode back to text containing the source material
+    text = tok.decode(train.tolist(), skip_special_tokens=True)
+    assert "add" in text
