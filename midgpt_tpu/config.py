@@ -76,6 +76,10 @@ class ExperimentConfig:
     # chooses collectives (reference parity); 'shard_map' = explicit per-layer
     # all-gather / grad reduce-scatter (parallel/shard_map_fsdp.py).
     fsdp_mode: str = "gspmd"
+    # With mesh.tp > 1: also shard wte/lm_head's vocab axis over 'tp'
+    # (Megatron vocab-parallel embedding + CE, parallel/tp.py). No effect at
+    # tp=1.
+    tp_vocab: bool = True
     debug: bool = False
 
     def __post_init__(self):
@@ -101,6 +105,11 @@ class ExperimentConfig:
                 raise ValueError(f"n_head={mc.n_head} not divisible by mesh.tp={tp}")
             if (4 * mc.n_embd) % tp != 0:
                 raise ValueError(f"4*n_embd={4 * mc.n_embd} not divisible by mesh.tp={tp}")
+            if self.tp_vocab and mc.vocab_size % tp != 0:
+                raise ValueError(
+                    f"vocab_size={mc.vocab_size} not divisible by mesh.tp={tp} "
+                    "(set tp_vocab=False or pad the vocab)"
+                )
             if self.fsdp_mode != "gspmd":
                 raise ValueError("mesh.tp > 1 requires fsdp_mode='gspmd'")
             if mc.attn_impl == "ring":

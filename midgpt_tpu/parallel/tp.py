@@ -20,8 +20,16 @@ Everything between a column-parallel and its matching row-parallel matmul
 feature/head axis, so GSPMD propagates the shard through with zero
 collectives; the row-parallel contraction produces partial sums and the
 residual-add's replicated requirement makes XLA place exactly the one
-all-reduce per half-block that Megatron prescribes. The embedding/lm_head
-stay on the FSDP rule (vocab-parallel CE is a separate schedule).
+all-reduce per half-block that Megatron prescribes.
+
+With `vocab_parallel` (the default when tp > 1, config field `tp_vocab`)
+the embedding and lm_head also shard their VOCAB axis over 'tp' — the
+Megatron vocab-parallel schedule: the token-embedding lookup becomes a
+masked local gather + all-reduce, and the fused CE loss's per-chunk
+reductions (max / sum-exp / label-logit gather, ops/loss.py) reduce over
+the sharded vocab axis with (chunk,)-sized psums. Each tp shard then holds
+only V/tp x D of the two largest leaves in the model. Everything is
+expressed through these specs; GSPMD authors the collectives.
 
 FSDP composes on the leaf's OTHER feature axis: each tp shard's weights are
 further sharded/gathered over 'fsdp', i.e. standard 2D (tp × zero-3) layout.
@@ -42,6 +50,7 @@ from midgpt_tpu.parallel.fsdp import fsdp_param_specs
 # leaf field name -> axis (from the end) that shards over 'tp'
 _COLUMN_PARALLEL = {"wqkv": 2, "w_up": 2}  # output features = axis -2
 _ROW_PARALLEL = {"wo": 1, "w_down": 1}  # input features = axis -1
+_VOCAB_PARALLEL = {"wte": 2, "lm_head": 2}  # vocab axis = axis -2 of (V, D)
 
 
 def _leaf_name(path: tp.Tuple[tp.Any, ...]) -> str:
@@ -58,9 +67,11 @@ def tp_param_specs(
     mesh: Mesh,
     shard_model: bool = True,
     min_size: int = 2**18,
+    vocab_parallel: bool = True,
 ) -> tp.Any:
     """Pytree of PartitionSpecs: Megatron 'tp' on the four block projections
-    (composed with 'fsdp' on their other feature axis), the plain FSDP rule
+    (composed with 'fsdp' on their other feature axis) and — with
+    `vocab_parallel` — on the vocab axis of wte/lm_head; the plain FSDP rule
     (parallel/fsdp.py) everywhere else. With mesh tp=1 this IS the FSDP rule."""
     n_tp = mesh.shape["tp"]
     n_fsdp = mesh.shape["fsdp"]
@@ -74,6 +85,8 @@ def tp_param_specs(
             tp_ax = x.ndim - _COLUMN_PARALLEL[name]
         elif name in _ROW_PARALLEL:
             tp_ax = x.ndim - _ROW_PARALLEL[name]
+        elif vocab_parallel and name in _VOCAB_PARALLEL:
+            tp_ax = x.ndim - _VOCAB_PARALLEL[name]
         else:
             return base_spec
         if x.ndim < 2 or x.shape[tp_ax] % n_tp != 0:

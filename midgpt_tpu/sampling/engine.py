@@ -38,7 +38,9 @@ def sample_logits(
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        # lax.top_k is O(V) selection of k values — not a full-vocab sort
+        # per token (the nucleus path below can't avoid its sort).
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
         # nucleus: keep the smallest prefix of descending-prob tokens whose
@@ -79,6 +81,7 @@ def _decode_and_sample(config, params, token, cache, temperature, top_k, top_p, 
 # per-token python loop is latency-bound; a lax.scan of decode steps inside
 # one jit amortizes the dispatch over the whole chunk.
 DECODE_CHUNK = 64
+assert DECODE_CHUNK & (DECODE_CHUNK - 1) == 0, "tail decomposition assumes a power of two"
 
 
 @functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 7), donate_argnums=(3,))
@@ -133,15 +136,19 @@ def generate(
     # Decode call #i writes K/V at position T_ctx + i; a chunk of n steps
     # starting at call index (produced - 1) last writes T_ctx + produced +
     # n - 2, which must stay <= S - 1. Chunks run as one device program
-    # (DECODE_CHUNK tokens per dispatch); the final partial chunk costs one
-    # extra compilation of the same scan at its length.
+    # (DECODE_CHUNK tokens per dispatch); a partial tail is decomposed into
+    # power-of-two chunks, so the scan only ever compiles at lengths
+    # {DECODE_CHUNK, DECODE_CHUNK/2, ..., 1} — a bounded, request-pattern-
+    # independent compile set (at most log2(DECODE_CHUNK) extra dispatches
+    # per generation).
     T_ctx = int(min(T0, S))
     while produced < max_new_tokens and T_ctx + produced <= S:
-        n = min(
+        budget = min(
             DECODE_CHUNK,
             max_new_tokens - produced,
             S - T_ctx - produced + 1,
         )
+        n = 1 << (budget.bit_length() - 1)  # largest power of two <= budget
         key, k = jax.random.split(key)
         nxt, cache, toks = _decode_chunk(
             config, params, nxt, cache, temperature, top_k, top_p, n, k

@@ -171,8 +171,9 @@ def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp
     )
     # Spec rule: Megatron tp x fsdp (parallel/tp.py) — with mesh tp=1 it
     # reduces to the plain FSDP rule exactly (pinned by test_tp.py).
-    from midgpt_tpu.parallel.tp import tp_param_specs as spec_rule
+    from midgpt_tpu.parallel.tp import tp_param_specs
 
+    spec_rule = functools.partial(tp_param_specs, vocab_parallel=config.tp_vocab)
     param_specs = spec_rule(
         abstract_params, mesh, config.shard_model, config.fsdp_min_size
     )

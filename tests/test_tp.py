@@ -34,9 +34,14 @@ def test_tp_spec_placement():
     # row-parallel: 'tp' on input features
     assert specs.blocks.attn.wo == P(None, "fsdp", "tp")
     assert specs.blocks.mlp.w_down == P(None, "fsdp", "tp")
-    # embedding / lm_head stay on the FSDP rule (replicated over 'tp')
-    assert specs.wte == P(None, "fsdp")
-    assert specs.lm_head == P(None, "fsdp")
+    # vocab-parallel (default): wte/lm_head shard the vocab axis over 'tp'
+    assert specs.wte == P("tp", "fsdp")
+    assert specs.lm_head == P("tp", "fsdp")
+    # with vocab_parallel off they fall back to the FSDP rule
+    specs_nv = tp_param_specs(params, mesh, True, 0, vocab_parallel=False)
+    assert specs_nv.wte == P(None, "fsdp")
+    assert specs_nv.lm_head == P(None, "fsdp")
+    assert specs_nv.blocks.attn.wqkv == P(None, "tp", "fsdp")
     # optimizer-state-shaped trees (params nested deeper) get the same rule
     opt_like = {"mu": params, "nu": params, "count": jnp.zeros(())}
     opt_specs = tp_param_specs(opt_like, mesh, shard_model=True, min_size=0)
@@ -76,7 +81,9 @@ def test_tp_forward_is_collective_minimal():
     boundaries at the qkv unpack and forces GSPMD to reshard every block."""
     mesh = make_mesh(MeshConfig(data=2, fsdp=1, sp=1, tp=4))
     params = GPT.init(CFG, jax.random.PRNGKey(0))
-    specs = tp_param_specs(params, mesh, shard_model=True, min_size=0)
+    # vocab_parallel off: full logits out of GPT.apply would legitimately
+    # need a vocab gather; the property under test is the BLOCK schedule.
+    specs = tp_param_specs(params, mesh, shard_model=True, min_size=0, vocab_parallel=False)
     sharded = jax.jit(lambda p: constrain(p, specs, mesh))(params)
     xg = make_global_batch(np.zeros((8, 32), np.int32), mesh, batch_spec(with_accum=False))
     hlo = (
@@ -87,6 +94,32 @@ def test_tp_forward_is_collective_minimal():
     )
     for banned in ("all-gather", "all-to-all", "collective-permute"):
         assert banned not in hlo, f"unexpected {banned} in tp forward"
+
+
+def test_tp_vocab_parallel_loss_schedule():
+    """Pin the vocab-parallel collective schedule (parallel/tp.py docstring):
+    the fused CE over a tp-sharded lm_head must lower to small per-chunk
+    psums — never an all-gather (which would rematerialize the V-sized
+    buffers the sharding exists to split)."""
+    from midgpt_tpu.ops.loss import fused_linear_cross_entropy
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=1, sp=1, tp=4))
+    params = GPT.init(CFG, jax.random.PRNGKey(0))
+    specs = tp_param_specs(params, mesh, shard_model=True, min_size=0)
+    assert specs.lm_head == P("tp", None)  # fsdp=1 here: tp on vocab only
+    sharded = jax.jit(lambda p: constrain(p, specs, mesh))(params)
+    x = make_global_batch(np.zeros((8, 32), np.int32), mesh, batch_spec(with_accum=False))
+    y = make_global_batch(np.ones((8, 32), np.int32), mesh, batch_spec(with_accum=False))
+
+    def loss_fn(p, xx, yy):
+        h = GPT.hidden(CFG, p, xx, inference=True)
+        return fused_linear_cross_entropy(h, p.lm_head, yy, 8192)
+
+    hlo = (
+        jax.jit(jax.value_and_grad(loss_fn)).lower(sharded, x, y).compile().as_text()
+    )
+    for banned in ("all-gather", "all-to-all", "collective-permute"):
+        assert banned not in hlo, f"unexpected {banned} in vocab-parallel loss"
 
 
 def _run_steps(cfg: ExperimentConfig, data_dir: str, n: int = 5):
@@ -159,6 +192,20 @@ def test_tp_config_validation():
     )
     with pytest.raises(ValueError, match="n_head"):
         ExperimentConfig(mesh=MeshConfig(tp=2), model_config=mc, **kw)
+    with pytest.raises(ValueError, match="vocab_size"):
+        ExperimentConfig(
+            mesh=MeshConfig(tp=2),
+            model_config=GPTConfig(block_size=32, vocab_size=65, n_layer=1,
+                                   n_head=2, n_embd=64),
+            **kw,
+        )
+    # ... but indivisible vocab is fine with tp_vocab off
+    ExperimentConfig(
+        mesh=MeshConfig(tp=2), tp_vocab=False,
+        model_config=GPTConfig(block_size=32, vocab_size=65, n_layer=1,
+                               n_head=2, n_embd=64),
+        **kw,
+    )
     with pytest.raises(ValueError, match="gspmd"):
         ExperimentConfig(
             mesh=MeshConfig(tp=2), fsdp_mode="shard_map",
