@@ -112,8 +112,6 @@ class ExperimentConfig:
                 )
             if self.fsdp_mode != "gspmd":
                 raise ValueError("mesh.tp > 1 requires fsdp_mode='gspmd'")
-            if mc.attn_impl == "ring":
-                raise ValueError("mesh.tp > 1 does not compose with attn_impl='ring' yet")
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

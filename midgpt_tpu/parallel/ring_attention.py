@@ -321,10 +321,16 @@ def ring_attention_sharded(
     batch_axes: tp.Tuple[str, ...] = ("data", "fsdp"),
     block_size: int = 1024,
     use_kernel: tp.Optional[bool] = None,
+    head_axis: tp.Optional[str] = None,
 ) -> Array:
     """shard_map wrapper: shards T over `axis_name`, batch over `batch_axes`,
-    runs the ring, returns the (B, H, T, C) result with the same layout."""
-    spec = P(batch_axes, None, axis_name, None)
+    runs the ring, returns the (B, H, T, C) result with the same layout.
+
+    `head_axis` (e.g. 'tp') additionally shards the head axis — the ring is
+    head-independent, so Megatron tensor parallelism and sequence parallelism
+    compose here with no extra collectives: each (tp, sp) device runs the
+    ring over its own H/tp heads' T/sp shard."""
+    spec = P(batch_axes, head_axis, axis_name, None)
     # nondiff_argnums of a custom_vjp function must be passed positionally
     fn = jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name, block_size, use_kernel),

@@ -60,7 +60,13 @@ def make_train_step(
     if model_cfg.attn_impl == "ring":
         from midgpt_tpu.parallel.ring_attention import ring_attention_sharded
 
-        attn_fn = functools.partial(ring_attention_sharded, mesh=mesh)
+        attn_fn = functools.partial(
+            ring_attention_sharded,
+            mesh=mesh,
+            # tp x sp composition: the ring is head-independent, so with a
+            # real 'tp' axis each device runs the ring over its head shard.
+            head_axis="tp" if mesh.shape["tp"] > 1 else None,
+        )
 
     if config.fsdp_mode == "shard_map":
         from midgpt_tpu.parallel.shard_map_fsdp import make_shard_map_loss

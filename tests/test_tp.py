@@ -182,6 +182,44 @@ def test_tp_train_step_matches_fsdp_only(data_dir):
     assert losses_ref[-1] < losses_ref[0]  # and it actually learns
 
 
+def test_tp_ring_sp_composition_matches_fsdp_only(data_dir):
+    """All four parallelism kinds at once: a (data=1, fsdp=2, sp=2, tp=2)
+    mesh — real FSDP param sharding, ring attention over 'sp', and
+    Megatron-sharded (head-sharded, via ring's head_axis) projections over
+    'tp' — must reproduce the FSDP-only oracle's loss trajectory."""
+    import dataclasses
+
+    base = dict(
+        rundir="",
+        data_dir=data_dir,
+        learning_rate=1e-2,
+        batch_size=8,
+        warmup_steps=5,
+        min_lr=1e-3,
+        lr_decay_steps=60,
+        max_steps=60,
+        beta2=0.99,
+        weight_decay=1e-4,
+        eval_interval=30,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=1,
+        shard_model=True,
+        eval_steps=2,
+        fsdp_min_size=0,
+    )
+    ref = ExperimentConfig(
+        mesh=MeshConfig(data=2, fsdp=4, sp=1), model_config=CFG, **base
+    )
+    ring_cfg = dataclasses.replace(CFG, attn_impl="ring")
+    tpsp = ExperimentConfig(
+        mesh=MeshConfig(data=1, fsdp=2, sp=2, tp=2), model_config=ring_cfg, **base
+    )
+    losses_ref = _run_steps(ref, data_dir, n=4)
+    losses_tpsp = _run_steps(tpsp, data_dir, n=4)
+    np.testing.assert_allclose(losses_tpsp, losses_ref, rtol=2e-5, atol=2e-5)
+
+
 def test_tp_config_validation():
     mc = GPTConfig(block_size=32, vocab_size=64, n_layer=1, n_head=3, n_embd=48)
     kw = dict(
