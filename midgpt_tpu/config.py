@@ -25,9 +25,9 @@ class MeshConfig:
     (reference train.py:130) — i.e. batch over both axes, params over the
     8-wide axis. Here the axes are named for their role: batch shards over
     ('data', 'fsdp'), params over 'fsdp', the sequence axis over 'sp'
-    (context parallelism; 1 unless ring attention is on), and the block
-    projections' feature axes over 'tp' (Megatron tensor parallelism,
-    parallel/tp.py; 1 unless enabled).
+    (context parallelism — ring or Ulysses attention; 1 unless one of them
+    is on), and the block projections' feature axes over 'tp' (Megatron
+    tensor parallelism, parallel/tp.py; 1 unless enabled).
     """
 
     data: int = -1  # -1: infer as n_devices // (fsdp * sp * tp)
@@ -112,6 +112,22 @@ class ExperimentConfig:
                 )
             if self.fsdp_mode != "gspmd":
                 raise ValueError("mesh.tp > 1 requires fsdp_mode='gspmd'")
+        sp = self.mesh.sp
+        if sp == -1:
+            sp = 1
+        if mc.attn_impl == "ulysses":
+            # Ulysses re-shards heads over sp (after any tp head sharding):
+            # every (tp, sp) device needs whole heads.
+            if sp > 1 and mc.n_head % (tp * sp) != 0:
+                raise ValueError(
+                    f"attn_impl='ulysses' needs n_head % (tp*sp) == 0, got "
+                    f"n_head={mc.n_head}, tp={tp}, sp={sp}"
+                )
+            if self.fsdp_mode == "shard_map":
+                raise ValueError(
+                    "attn_impl='ulysses' composes only with fsdp_mode='gspmd' "
+                    "(the shard_map body wires the ring)"
+                )
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -57,11 +57,13 @@ class GPTConfig:
     n_embd: int
     dropout: float = 0.0
     # TPU knobs (not part of the reference config surface):
-    # 'ring' = sequence-parallel ring attention over the mesh 'sp' axis
-    # (parallel/ring_attention.py); the runtime injects the mesh-bound
-    # implementation via the attn_fn hook on GPT.hidden.
-    attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash' | 'ring'
-    attn_block_size: int = 512  # tile size for blockwise/flash paths
+    # 'ring' / 'ulysses' = sequence parallelism over the mesh 'sp' axis
+    # (parallel/ring_attention.py: K/V shards rotate by ppermute;
+    # parallel/ulysses.py: one all-to-all trades the sequence sharding for a
+    # head sharding and attention runs dense); the runtime injects the
+    # mesh-bound implementation via the attn_fn hook on GPT.hidden.
+    attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash' | 'ring' | 'ulysses'
+    attn_block_size: int = 512  # tile size: blockwise/flash/ring/ulysses paths
     remat: bool = True  # checkpoint each block inside the layer scan
     # What the per-block checkpoint may keep instead of recomputing in bwd:
     #   'none'  — save nothing (full recompute; minimum memory)
@@ -303,9 +305,9 @@ class GPT:
             # sequence parallelism) — head-major like the kernels.
             if config.dropout != 0.0 and not inference:
                 raise NotImplementedError(
-                    "injected attention (attn_impl='ring') does not support "
-                    "attention-probability dropout; use attn_impl='naive' or "
-                    "set dropout=0.0"
+                    f"injected attention (attn_impl={config.attn_impl!r}) does "
+                    "not support attention-probability dropout; use "
+                    "attn_impl='naive' or set dropout=0.0"
                 )
             att = attn_fn(
                 q.transpose(0, 2, 1, 3),

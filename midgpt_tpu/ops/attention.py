@@ -174,12 +174,12 @@ def multihead_attention(
     """
     if layout not in ("bhtc", "bthc"):
         raise ValueError(f"unknown attention layout {layout!r}")
-    if impl not in ("naive", "blockwise", "flash", "ring"):
+    if impl not in ("naive", "blockwise", "flash", "ring", "ulysses"):
         raise ValueError(f"unknown attention impl {impl!r}")
-    if impl == "ring":
-        # The mesh-bound ring implementation is injected by the training
-        # runtime (GPT.hidden attn_fn). Reached without it — sampling or
-        # evaluating a ring-trained checkpoint on a single host — the
+    if impl in ("ring", "ulysses"):
+        # The mesh-bound sequence-parallel implementations are injected by
+        # the training runtime (GPT.hidden attn_fn). Reached without one —
+        # sampling or evaluating such a checkpoint on a single host — the
         # unsharded math is identical to blockwise online softmax.
         impl = "blockwise"
     if impl != "naive" and dropout_rate != 0.0 and not inference:

@@ -5,9 +5,10 @@ batch over both axes, params over the 8-wide axis (reference train.py:130),
 which requires device counts divisible by 8. Here axis sizes come from config
 with -1 inference, `mesh_utils.create_device_mesh` picks the physical layout
 so 'fsdp' collectives (the per-layer all-gathers/reduce-scatters) ride
-contiguous ICI links, 'sp' is the context-parallel axis for ring attention,
-and 'tp' is the tensor-parallel axis (Megatron column/row sharding of the
-block projections, parallel/tp.py) — both size 1 unless enabled.
+contiguous ICI links, 'sp' is the context-parallel axis (ring or Ulysses
+attention), and 'tp' is the tensor-parallel axis (Megatron column/row
+sharding of the block projections, parallel/tp.py) — both size 1 unless
+enabled.
 """
 
 from __future__ import annotations

@@ -63,8 +63,18 @@ def make_train_step(
         attn_fn = functools.partial(
             ring_attention_sharded,
             mesh=mesh,
+            block_size=model_cfg.attn_block_size,
             # tp x sp composition: the ring is head-independent, so with a
             # real 'tp' axis each device runs the ring over its head shard.
+            head_axis="tp" if mesh.shape["tp"] > 1 else None,
+        )
+    elif model_cfg.attn_impl == "ulysses":
+        from midgpt_tpu.parallel.ulysses import ulysses_attention_sharded
+
+        attn_fn = functools.partial(
+            ulysses_attention_sharded,
+            mesh=mesh,
+            block_size=model_cfg.attn_block_size,
             head_axis="tp" if mesh.shape["tp"] > 1 else None,
         )
 
