@@ -87,6 +87,10 @@ class ExperimentConfig:
         # attention-probability dropout exists only on the naive path
         # (ops/attention.py dispatch).
         mc = self.model_config
+        if mc.qkv_proj not in ("fused", "split3"):
+            # A typo here would silently fall back to the fused lowering AND
+            # bypass the tp auto-switch (training/train.py) — fail loudly.
+            raise ValueError(f"unknown qkv_proj {mc.qkv_proj!r} ('fused' or 'split3')")
         if mc.dropout > 0.0 and mc.attn_impl != "naive":
             raise ValueError(
                 f"attn_impl={mc.attn_impl!r} does not support attention "

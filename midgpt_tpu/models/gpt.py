@@ -63,7 +63,10 @@ class GPTConfig:
     # head sharding and attention runs dense); the runtime injects the
     # mesh-bound implementation via the attn_fn hook on GPT.hidden.
     attn_impl: str = "naive"  # 'naive' | 'blockwise' | 'flash' | 'ring' | 'ulysses'
-    attn_block_size: int = 512  # tile size: blockwise/flash/ring/ulysses paths
+    # Tile size for the blockwise/flash/ring/ulysses paths. 1024 measured 7
+    # MFU points faster than 512 on the 124M flash training step (v5e,
+    # RESULTS §4a) and matches the ring's tuned per-pair tile.
+    attn_block_size: int = 1024
     remat: bool = True  # checkpoint each block inside the layer scan
     # What the per-block checkpoint may keep instead of recomputing in bwd:
     #   'none'  — save nothing (full recompute; minimum memory)
@@ -78,6 +81,11 @@ class GPTConfig:
     #             ~4 (B,T,D)-sized buffers per layer
     remat_policy: str = "dots"
     scan_unroll: int = 1  # unroll factor of the layer scan
+    # QKV projection lowering of the (3, D, D) weight (see _project_qkv):
+    # 'fused' = one (BT,D)x(D,3D) matmul (best MXU shape, default);
+    # 'split3' = batched per-third einsum (required under tensor parallelism
+    # — auto-selected by the training runtime when mesh tp > 1).
+    qkv_proj: str = "fused"
 
     @property
     def head_dim(self) -> int:
@@ -87,14 +95,23 @@ class GPTConfig:
 
 @pytree_dataclass
 class AttentionParams:
-    # (3D, D) fused QKV projection, applied as W @ x. Output rows are
-    # HEAD-MAJOR interleaved — H blocks of (q_h, k_h, v_h), each (3C, D) —
-    # not the stacked [q; k; v]: the unpack is then a free reshape to
-    # (B, T, H, 3, C), and sharding the 3D axis over the mesh 'tp' axis
-    # (parallel/tp.py) puts WHOLE heads on each shard (boundaries at
-    # (H/tp)*3C align with head groups), which is what makes Megatron TP
-    # collective-free between the column- and row-parallel matmuls. The
-    # reference's stacked-qkv split (reference model.py:63-66) is a row
+    # (3, D, D) fused QKV projection with an explicit leading q/k/v axis.
+    # This layout holds two properties at once that flat (3D, D) layouts
+    # each break:
+    #   * at tp=1 it reshapes (free: contiguous) to the flat stacked (3D, D)
+    #     for ONE full-width matmul + contiguous split — the fast MXU path
+    #     (a head-major interleaved flat layout costs ~1.7 MFU points at
+    #     C=64, measured, RESULTS §4: its (B,T,H,3,C) unpack slices leave
+    #     64-element lane runs);
+    #   * Megatron TP shards axis 1 (output features, parallel/tp.py): each
+    #     of q, k, v is column-sharded independently, so shard boundaries
+    #     land between whole heads (D = H*C head-major) and the schedule is
+    #     collective-free between the column- and row-parallel matmuls —
+    #     sharding a flat stacked 3D axis would straddle the q/k/v
+    #     boundaries. (The two lowerings: GPTConfig.qkv_proj.)
+    # Shape-distinct from both flat layouts, so a checkpoint from either
+    # fails loudly at restore instead of silently permuting rows.
+    # The reference's flat stacked split (reference model.py:63-66) is a row
     # permutation of this; init rows are iid so the distribution is
     # identical.
     wqkv: Array
@@ -197,7 +214,9 @@ class GPT:
         def init_block(k: KeyArray) -> BlockParams:
             k_attn, k_proj, k_up, k_down = jax.random.split(k, 4)
             attn = AttentionParams(
-                wqkv=_linear_init(k_attn, 3 * D, D),
+                # iid rows: the (3, D, D) reshape of a (3D, D) init is the
+                # same distribution as the reference's flat fused projection
+                wqkv=_linear_init(k_attn, 3 * D, D).reshape(3, D, D),
                 wo=_linear_init(k_proj, D, D),
                 q_scale=jnp.ones((C,)),
                 k_scale=jnp.ones((C,)),
@@ -221,17 +240,29 @@ class GPT:
 
         Sequence-major (B, T, H, C) is the layout the fused projection
         produces with a plain reshape; the flash kernel consumes it natively,
-        so the training hot path never materializes a head transpose. The
-        head-major interleaved wqkv layout (see AttentionParams) makes the
-        unpack a reshape + unstack along a replicated axis — under tensor
-        parallelism the H axis arrives already sharded, no resharding."""
+        so the training hot path never materializes a head transpose.
+
+        Two lowerings of the same (3, D, D) weight (see AttentionParams and
+        GPTConfig.qkv_proj):
+          'fused'  — reshape the weight flat (free: contiguous) and run ONE
+                     (BT, D) x (D, 3D) matmul; best MXU shape, the default.
+          'split3' — batched per-third einsum: under tensor parallelism the
+                     flat reshape would mix the tp-sharded feature axis into
+                     the merged 3D axis (a reshard); the batched form keeps
+                     each third independently column-sharded, zero
+                     collectives. The runtime selects this when mesh tp > 1
+                     (training/train.py)."""
         B, T, D = h.shape
         H, C = config.n_head, config.head_dim
-        qkv = jnp.einsum("btd,ed->bte", h, block.attn.wqkv)
-        qkv = qkv.reshape(B, T, H, 3, C)
-        q = head_layer_norm(qkv[..., 0, :], block.attn.q_scale)
-        k = head_layer_norm(qkv[..., 1, :], block.attn.k_scale)
-        v = qkv[..., 2, :]
+        if config.qkv_proj == "split3":
+            qkv = jnp.einsum("btd,xed->btxe", h, block.attn.wqkv)  # (B, T, 3, D)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            qkv = jnp.einsum("btd,ed->bte", h, block.attn.wqkv.reshape(3 * D, D))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = head_layer_norm(q.reshape(B, T, H, C), block.attn.q_scale)
+        k = head_layer_norm(k.reshape(B, T, H, C), block.attn.k_scale)
+        v = v.reshape(B, T, H, C)
         return q, k, v
 
     @staticmethod

@@ -6,11 +6,13 @@ The GPT block has exactly four projections, and the classic Megatron-LM
 schedule falls out of sharding them over the mesh 'tp' axis:
 
   column-parallel (shard the OUTPUT features):
-    wqkv  (L, 3D, D) -> P(None, 'tp', 'fsdp')   whole heads per shard — the
-        stacked axis is head-major interleaved (H blocks of (q,k,v), see
-        models/gpt.py AttentionParams), so shard boundaries at (H/tp)*3C
-        fall between head groups, never inside q/k/v
-    w_up  (L, 4D, D) -> P(None, 'tp', 'fsdp')   whole MLP columns per shard
+    wqkv  (L, 3, D, D) -> P(None, None, 'tp', 'fsdp')   whole heads per
+        shard: the explicit leading q/k/v axis (models/gpt.py
+        AttentionParams) means each of q, k, v is column-sharded
+        independently on its own D = H*C head-major feature axis — shard
+        boundaries never straddle q/k/v or split a head. (Requires the
+        'split3' QKV lowering, auto-selected by the runtime under tp > 1.)
+    w_up  (L, 4D, D)   -> P(None, 'tp', 'fsdp')   whole MLP columns per shard
   row-parallel (shard the INPUT / contraction features):
     wo     (L, D, D)  -> P(None, 'fsdp', 'tp')
     w_down (L, D, 4D) -> P(None, 'fsdp', 'tp')

@@ -82,13 +82,15 @@ def ulysses_attention_sharded(
     batch_axes: tp.Tuple[str, ...] = ("data", "fsdp"),
     block_size: int = 512,
     head_axis: tp.Optional[str] = None,
+    impl: str = "flash",
 ) -> Array:
     """shard_map wrapper, same contract as ring_attention_sharded: shards T
     over `axis_name` (and heads over `head_axis`, e.g. 'tp'), returns the
-    (B, H, T, C) result with the same layout."""
+    (B, H, T, C) result with the same layout. `impl` selects the inner dense
+    attention ('flash' kernel-dispatched; 'blockwise'/'naive' for debug)."""
     spec = P(batch_axes, head_axis, axis_name, None)
     fn = jax.shard_map(
-        lambda q, k, v: ulysses_attention(q, k, v, axis_name, block_size),
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name, block_size, impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -29,11 +29,15 @@ import orbax.checkpoint as ocp
 
 # Format marker saved alongside the state and verified at restore. Version
 # history:
-#   2 — wqkv rows are head-major interleaved (models/gpt.py AttentionParams);
-#       version-1 checkpoints (stacked [q;k;v]) would restore without any
-#       shape error but every head would read other heads' projection rows,
-#       so restore REFUSES checkpoints without a matching marker.
-FORMAT = {"version": 2, "qkv_layout": "head_major"}
+#   2 — wqkv rows were flat (3D, D) head-major interleaved; a flat stacked
+#       checkpoint would restore into it without any shape error but every
+#       head would read other heads' projection rows, so restore REFUSES
+#       checkpoints without a matching marker.
+#   3 — wqkv is (3, D, D) (models/gpt.py AttentionParams): shape-distinct
+#       from both flat layouts, so cross-layout restores also fail loudly at
+#       the orbax level; the marker remains the explicit, diagnosable gate.
+#       tools/migrate_ckpt_v2_v3.py converts v2 checkpoints in place.
+FORMAT = {"version": 3, "qkv_layout": "qkv3"}
 
 
 def _abstract_like(tree: tp.Any) -> tp.Any:

@@ -48,6 +48,13 @@ def make_train_step(
 ) -> tp.Tuple[tp.Callable, tp.Callable, tp.Callable]:
     """Build (step, eval_loss, eval_loss_many) jitted functions."""
     model_cfg = config.model_config
+    if mesh.shape["tp"] > 1 and model_cfg.qkv_proj == "fused":
+        # The fused lowering reshapes the tp-sharded feature axis into the
+        # merged 3D axis (a reshard); the batched per-third form keeps each
+        # of q/k/v independently column-sharded (models/gpt.py _project_qkv).
+        import dataclasses
+
+        model_cfg = dataclasses.replace(model_cfg, qkv_proj="split3")
     compute_dtype = jnp.dtype(config.compute_dtype)
     G = config.g_accum_iters
 

@@ -21,7 +21,7 @@ def test_init_shapes(params):
     D, C, L, V = CFG.n_embd, CFG.head_dim, CFG.n_layer, CFG.vocab_size
     assert params.wte.shape == (V, D)
     assert params.lm_head.shape == (V, D)
-    assert params.blocks.attn.wqkv.shape == (L, 3 * D, D)
+    assert params.blocks.attn.wqkv.shape == (L, 3, D, D)
     assert params.blocks.attn.wo.shape == (L, D, D)
     assert params.blocks.attn.q_scale.shape == (L, C)
     assert params.blocks.mlp.w_up.shape == (L, 4 * D, D)

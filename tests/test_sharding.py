@@ -41,7 +41,7 @@ def test_fsdp_specs_shard_large_replicate_small():
     # Big 2D+ leaves sharded over 'fsdp' on exactly one axis:
     assert specs.wte == P(None, "fsdp")
     assert specs.lm_head == P(None, "fsdp")
-    assert specs.blocks.attn.wqkv == P(None, None, "fsdp")
+    assert specs.blocks.attn.wqkv == P(None, None, None, "fsdp")
     assert specs.blocks.mlp.w_up == P(None, None, "fsdp")
     # per-head norm scales: (L, C) with C=32 not divisible by 4 on last axis?
     # C=32 divisible; but skip_leading keeps axis 1: either sharded or replicated is legal.

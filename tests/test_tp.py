@@ -21,7 +21,12 @@ from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
 from midgpt_tpu.parallel.tp import tp_param_specs
 from midgpt_tpu.training.train import init_state, make_train_step
 
+import dataclasses
+
 CFG = GPTConfig(block_size=32, vocab_size=256, n_layer=2, n_head=4, n_embd=64)
+# What make_train_step selects under tp > 1: the batched per-third QKV
+# lowering that keeps each of q/k/v independently column-sharded.
+CFG3 = dataclasses.replace(CFG, qkv_proj="split3")
 
 
 def test_tp_spec_placement():
@@ -29,7 +34,7 @@ def test_tp_spec_placement():
     params = GPT.init(CFG, jax.random.PRNGKey(0))
     specs = tp_param_specs(params, mesh, shard_model=True, min_size=0)
     # column-parallel: 'tp' on output features, 'fsdp' composed on input
-    assert specs.blocks.attn.wqkv == P(None, "tp", "fsdp")
+    assert specs.blocks.attn.wqkv == P(None, None, "tp", "fsdp")
     assert specs.blocks.mlp.w_up == P(None, "tp", "fsdp")
     # row-parallel: 'tp' on input features
     assert specs.blocks.attn.wo == P(None, "fsdp", "tp")
@@ -41,11 +46,11 @@ def test_tp_spec_placement():
     specs_nv = tp_param_specs(params, mesh, True, 0, vocab_parallel=False)
     assert specs_nv.wte == P(None, "fsdp")
     assert specs_nv.lm_head == P(None, "fsdp")
-    assert specs_nv.blocks.attn.wqkv == P(None, "tp", "fsdp")
+    assert specs_nv.blocks.attn.wqkv == P(None, None, "tp", "fsdp")
     # optimizer-state-shaped trees (params nested deeper) get the same rule
     opt_like = {"mu": params, "nu": params, "count": jnp.zeros(())}
     opt_specs = tp_param_specs(opt_like, mesh, shard_model=True, min_size=0)
-    assert opt_specs["mu"].blocks.attn.wqkv == P(None, "tp", "fsdp")
+    assert opt_specs["mu"].blocks.attn.wqkv == P(None, None, "tp", "fsdp")
     assert opt_specs["count"] == P()
 
 
@@ -76,18 +81,18 @@ def test_tp_forward_is_collective_minimal():
     """The Megatron property, asserted on compiled HLO: with pure tp sharding
     the forward needs ONLY the two all-reduces per block body (after the
     row-parallel wo and w_down) — no all-gather / all-to-all / resharding of
-    activations. This is what the head-major interleaved wqkv layout buys
-    (models/gpt.py AttentionParams): a stacked [q;k;v] layout straddles shard
-    boundaries at the qkv unpack and forces GSPMD to reshard every block."""
+    activations. This is what the (3, D, D) wqkv layout + split3 lowering buy
+    (models/gpt.py AttentionParams): sharding a flat stacked [q;k;v] axis
+    straddles the q/k/v boundaries and forces GSPMD to reshard every block."""
     mesh = make_mesh(MeshConfig(data=2, fsdp=1, sp=1, tp=4))
-    params = GPT.init(CFG, jax.random.PRNGKey(0))
+    params = GPT.init(CFG3, jax.random.PRNGKey(0))
     # vocab_parallel off: full logits out of GPT.apply would legitimately
     # need a vocab gather; the property under test is the BLOCK schedule.
     specs = tp_param_specs(params, mesh, shard_model=True, min_size=0, vocab_parallel=False)
     sharded = jax.jit(lambda p: constrain(p, specs, mesh))(params)
     xg = make_global_batch(np.zeros((8, 32), np.int32), mesh, batch_spec(with_accum=False))
     hlo = (
-        jax.jit(lambda p, t: GPT.apply(CFG, p, t, inference=True))
+        jax.jit(lambda p, t: GPT.apply(CFG3, p, t, inference=True))
         .lower(sharded, xg)
         .compile()
         .as_text()
@@ -104,7 +109,7 @@ def test_tp_vocab_parallel_loss_schedule():
     from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 
     mesh = make_mesh(MeshConfig(data=2, fsdp=1, sp=1, tp=4))
-    params = GPT.init(CFG, jax.random.PRNGKey(0))
+    params = GPT.init(CFG3, jax.random.PRNGKey(0))
     specs = tp_param_specs(params, mesh, shard_model=True, min_size=0)
     assert specs.lm_head == P("tp", None)  # fsdp=1 here: tp on vocab only
     sharded = jax.jit(lambda p: constrain(p, specs, mesh))(params)
@@ -112,7 +117,7 @@ def test_tp_vocab_parallel_loss_schedule():
     y = make_global_batch(np.ones((8, 32), np.int32), mesh, batch_spec(with_accum=False))
 
     def loss_fn(p, xx, yy):
-        h = GPT.hidden(CFG, p, xx, inference=True)
+        h = GPT.hidden(CFG3, p, xx, inference=True)
         return fused_linear_cross_entropy(h, p.lm_head, yy, 8192)
 
     hlo = (

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Projection-gap grind (single chip, 124M shape): sweep the knobs that plausibly
+# move the ~140 TF/s projection rate / step composition (docs/ROADMAP.md §2),
+# one bench invocation per line, results appended as JSON lines to $OUT.
+# Usage: tools/grind_projections.sh [outfile]
+set -u
+OUT="${1:-/tmp/grind_results.jsonl}"
+: > "$OUT"
+run() {
+  echo "### $*" >> "$OUT"
+  python bench.py --steps 20 --warmup 3 "$@" 2>/dev/null | tail -1 >> "$OUT"
+}
+
+run                                  # baseline (B=16, remat off, unroll 1, chunk 8192)
+run --batch 24
+run --batch 32
+run --batch 24 --remat flash
+run --unroll 2
+run --unroll 4
+run --unroll 12                      # fully unrolled layer scan
+run --loss-chunk 4096
+run --loss-chunk 16384
+run --loss-chunk 32768
+run --attn-block 256
+run --attn-block 1024
+echo "grind done -> $OUT"

@@ -1,0 +1,88 @@
+"""Migrate a checkpoint from format v2 to v3 (training/checkpoint.py FORMAT).
+
+v2 stored the fused QKV projection as a flat (n_layer, 3D, D) head-major
+interleaved matrix (rows: H blocks of (q_h, k_h, v_h)); v3 stores it as
+(n_layer, 3, D, D) with an explicit q/k/v axis and head-major features
+within each D. The permutation, per layer:
+
+    (3D, D) --reshape--> (H, 3, C, D) --transpose--> (3, H, C, D)
+             --reshape--> (3, D, D)
+
+applied to every leaf whose path ends in `wqkv` — which covers the params
+AND the optimizer moments (mu/nu mirror the param tree). Everything else is
+copied through. The migrated checkpoint is written as a sibling step in a
+new directory (source is never modified) with the v3 format marker.
+
+Usage:
+    python tools/migrate_ckpt_v2_v3.py SRC_RUNDIR DST_RUNDIR --n-head H
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from midgpt_tpu.parallel.tp import _leaf_name
+from midgpt_tpu.training import checkpoint as ckpt_mod
+
+
+def migrate_tree(tree, n_head: int):
+    def rule(path, x):
+        if _leaf_name(path) == "wqkv":
+            L, threeD, D = x.shape
+            assert threeD == 3 * D, f"not a v2 wqkv: {x.shape}"
+            C = D // n_head
+            x = np.asarray(x).reshape(L, n_head, 3, C, D)
+            x = x.transpose(0, 2, 1, 3, 4).reshape(L, 3, D, D)
+        return x
+
+    return jax.tree_util.tree_map_with_path(rule, tree)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("src")
+    parser.add_argument("dst")
+    parser.add_argument("--n-head", type=int, required=True)
+    args = parser.parse_args()
+    src = os.path.abspath(args.src)
+    dst = os.path.abspath(args.dst)
+
+    reader = ocp.CheckpointManager(src)
+    step = reader.latest_step()
+    if step is None:
+        sys.exit(f"no checkpoint under {src}")
+    # Raw restore (numpy, no abstract template) + explicit marker check.
+    restored = reader.restore(
+        step,
+        args=ocp.args.Composite(
+            format=ocp.args.JsonRestore(),
+            params=ocp.args.StandardRestore(),
+            opt_state=ocp.args.StandardRestore(),
+        ),
+    )
+    fmt = restored["format"]
+    if fmt.get("version") != 2:
+        sys.exit(f"source is format {fmt}, not v2 — nothing to migrate")
+
+    out = {
+        "params": migrate_tree(restored["params"], args.n_head),
+        "opt_state": migrate_tree(restored["opt_state"], args.n_head),
+    }
+    reader.close()
+
+    writer = ckpt_mod.CheckpointManager(dst, save_interval_steps=1)
+    assert writer.save(step, out, force=True)
+    writer.close()
+    print(f"migrated step {step}: {src} (v2) -> {dst} (v{ckpt_mod.FORMAT['version']})")
+
+
+if __name__ == "__main__":
+    main()
