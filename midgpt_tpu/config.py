@@ -19,21 +19,23 @@ from midgpt_tpu.models.gpt import GPTConfig
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Logical 4D device mesh. Axis sizes of -1 are inferred at runtime.
+    """Logical 5D device mesh. Axis sizes of -1 are inferred at runtime.
 
     The reference hard-codes Mesh((n_devices // 8, 8), ('replica', 'data'))
     (reference train.py:130) — i.e. batch over both axes, params over the
     8-wide axis. Here the axes are named for their role: batch shards over
     ('data', 'fsdp'), params over 'fsdp', the sequence axis over 'sp'
     (context parallelism — ring or Ulysses attention; 1 unless one of them
-    is on), and the block projections' feature axes over 'tp' (Megatron
-    tensor parallelism, parallel/tp.py; 1 unless enabled).
+    is on), the block projections' feature axes over 'tp' (Megatron tensor
+    parallelism, parallel/tp.py), and the LAYER axis over 'pp' (GPipe
+    pipeline stages, parallel/pipeline.py) — both 1 unless enabled.
     """
 
-    data: int = -1  # -1: infer as n_devices // (fsdp * sp * tp)
+    data: int = -1  # -1: infer as n_devices // (fsdp * sp * tp * pp)
     fsdp: int = 8
     sp: int = 1
     tp: int = 1  # tensor parallelism (Megatron column/row, parallel/tp.py)
+    pp: int = 1  # pipeline parallelism (GPipe over stages, parallel/pipeline.py)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +82,10 @@ class ExperimentConfig:
     # (Megatron vocab-parallel embedding + CE, parallel/tp.py). No effect at
     # tp=1.
     tp_vocab: bool = True
+    # With mesh.pp > 1: number of GPipe microbatches per step (0 = one per
+    # pipeline stage). More microbatches shrink the pipeline bubble
+    # (pp-1 of M+pp-1 ticks) at the cost of smaller per-tick matmuls.
+    pipeline_microbatches: int = 0
     debug: bool = False
 
     def __post_init__(self):
@@ -116,6 +122,39 @@ class ExperimentConfig:
                 )
             if self.fsdp_mode != "gspmd":
                 raise ValueError("mesh.tp > 1 requires fsdp_mode='gspmd'")
+        pp = self.mesh.pp
+        if pp == -1:
+            pp = 1
+        if pp < 1:
+            raise ValueError(f"mesh.pp={pp} must be >= 1 (or -1 to infer)")
+        if self.pipeline_microbatches < 0:
+            raise ValueError(f"pipeline_microbatches={self.pipeline_microbatches} must be >= 0")
+        if pp > 1:
+            # v1 GPipe composes with data parallelism only (parallel/pipeline.py):
+            # stages shard the LAYER axis; fsdp/sp/tp sharding of the per-stage
+            # weights is future work.
+            if mc.n_layer % pp != 0:
+                raise ValueError(f"n_layer={mc.n_layer} not divisible by mesh.pp={pp}")
+            if mc.dropout != 0.0:
+                raise ValueError("mesh.pp > 1 requires dropout=0.0")
+            if self.fsdp_mode != "gspmd":
+                raise ValueError("mesh.pp > 1 requires fsdp_mode='gspmd'")
+            if self.mesh.fsdp not in (1, -1) or self.mesh.sp not in (1, -1) or tp != 1:
+                raise ValueError(
+                    "mesh.pp > 1 currently composes only with 'data' "
+                    "(set fsdp=1, sp=1, tp=1)"
+                )
+            if mc.attn_impl in ("ring", "ulysses"):
+                raise ValueError("mesh.pp > 1 does not compose with sequence parallelism yet")
+            mb = self.pipeline_microbatches or pp
+            # Necessary but not sufficient: the runtime constraint is on the
+            # per-data-shard LOCAL batch, unknowable here (data may be -1);
+            # make_pipeline_loss raises a config-pointing ValueError then.
+            if self.batch_size % mb != 0:
+                raise ValueError(
+                    f"batch_size={self.batch_size} not divisible by "
+                    f"pipeline_microbatches={mb}"
+                )
         sp = self.mesh.sp
         if sp == -1:
             sp = 1

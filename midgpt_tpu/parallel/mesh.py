@@ -22,7 +22,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.config import MeshConfig
 
-AXES = ("data", "fsdp", "sp", "tp")
+AXES = ("data", "fsdp", "sp", "tp", "pp")
+# The axes token batches shard over (batch_spec below; the shard_map loss
+# bodies pmean/fold-in over these).
+BATCH_AXES = ("data", "fsdp")
 
 
 def make_mesh(
@@ -36,18 +39,19 @@ def make_mesh(
     fsdp = cfg.fsdp if cfg.fsdp != -1 else 1
     sp = cfg.sp if cfg.sp != -1 else 1
     tp_ = cfg.tp if cfg.tp != -1 else 1
-    if n % (fsdp * sp * tp_) != 0:
+    pp = cfg.pp if cfg.pp != -1 else 1
+    if n % (fsdp * sp * tp_ * pp) != 0:
         # Degrade gracefully on small device counts (e.g. 1-chip dev boxes):
-        # clamp fsdp to the largest divisor of n // (sp * tp).
-        if n % (sp * tp_) != 0:
-            raise ValueError(f"{n} devices not divisible by sp={sp} * tp={tp_}")
-        rest = n // (sp * tp_)
+        # clamp fsdp to the largest divisor of n // (sp * tp * pp).
+        if n % (sp * tp_ * pp) != 0:
+            raise ValueError(f"{n} devices not divisible by sp={sp} * tp={tp_} * pp={pp}")
+        rest = n // (sp * tp_ * pp)
         fsdp = max(d for d in range(1, rest + 1) if rest % d == 0 and d <= fsdp)
-    data = cfg.data if cfg.data != -1 else n // (fsdp * sp * tp_)
-    if data * fsdp * sp * tp_ != n:
-        raise ValueError(f"mesh {data}x{fsdp}x{sp}x{tp_} != {n} devices")
+    data = cfg.data if cfg.data != -1 else n // (fsdp * sp * tp_ * pp)
+    if data * fsdp * sp * tp_ * pp != n:
+        raise ValueError(f"mesh {data}x{fsdp}x{sp}x{tp_}x{pp} != {n} devices")
     mesh_devices = mesh_utils.create_device_mesh(
-        (data, fsdp, sp, tp_), devices=np.asarray(devices)
+        (data, fsdp, sp, tp_, pp), devices=np.asarray(devices)
     )
     return Mesh(mesh_devices, axis_names=AXES)
 

@@ -1,0 +1,178 @@
+"""GPipe pipeline parallelism over the mesh 'pp' axis.
+
+Beyond the reference's capability set (its only model sharding is FSDP,
+reference model.py:167-178). The design falls out of this framework's
+model representation: block parameters are already STACKED along a leading
+layer axis (models/gpt.py), so a pipeline stage is nothing more than that
+axis sharded over 'pp' — stage s holds the (L/pp, ...) slice of every block
+leaf, and shard_map hands it each stage's slice with zero data movement.
+
+Schedule (classic GPipe, SPMD-expressed — every stage runs the SAME
+program every tick; there is no per-stage control flow to trace):
+
+  * the step's local batch is split into M microbatches; the embedded
+    activations (M, Bm, T, D) are visible to every stage (the 'pp' axis is
+    replicated for activations — only stage 0's use of them is real);
+  * one `lax.scan` runs M + pp - 1 ticks. Each tick, every stage runs its
+    layer slice on one activation: stage 0 reads microbatch t from the
+    input stream, stage s>0 reads what stage s-1 ppermuted to it last tick.
+    Tick outputs ride a single neighbor `ppermute`; the last stage collects
+    its finished microbatches into an output buffer by a masked
+    dynamic-index update (bubble ticks compute on garbage that is never
+    collected — static shapes, no `lax.cond`);
+  * loss: the last stage runs final-norm + fused CE on its collected
+    outputs; a `psum` over 'pp' of the masked per-stage value broadcasts
+    the scalar. Reverse-mode AD through the tick scan + ppermute IS the
+    GPipe backward schedule (ppermute transposes to the reverse
+    permutation; the scan's saved residuals are the activation stash), and
+    shard_map's transpose of the replicated wte/lm_head inputs inserts the
+    psum that combines stage 0's embedding grad and the last stage's head
+    grad.
+
+The pipeline bubble is the standard (pp-1)/(M+pp-1) fraction of ticks;
+`pipeline_microbatches` trades bubble against per-tick matmul size.
+
+v1 composes with the 'data' axis (batch sharding); fsdp/sp/tp sharding of
+the per-stage weights is future work (config validation enforces this).
+"""
+
+from __future__ import annotations
+
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, _remat_policy
+from midgpt_tpu.ops.norms import rms_norm
+from midgpt_tpu.ops.rope import rope_table
+from midgpt_tpu.ops.loss import fused_linear_cross_entropy
+from midgpt_tpu.parallel.mesh import BATCH_AXES
+
+Array = jax.Array
+
+
+def pipeline_param_specs(params: tp.Any) -> tp.Any:
+    """Specs for the GPipe schedule: block leaves shard their leading LAYER
+    axis over 'pp'; everything else replicated (v1 — see module docstring).
+    Works for params AND optimizer-state trees (path-keyed on 'blocks')."""
+
+    def rule_blocks(x) -> P:
+        spec: tp.List[tp.Any] = [None] * x.ndim
+        spec[0] = "pp"
+        return P(*spec)
+
+    def rule(path, x) -> P:
+        names = [getattr(e, "name", None) or getattr(e, "key", None) for e in path]
+        if "blocks" in names:
+            return rule_blocks(x)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def gpipe_stage_apply(
+    config: GPTConfig, stage_blocks, x: Array, rope
+) -> Array:
+    """Run this stage's (L/pp)-layer slice on one microbatch (Bm, T, D)."""
+
+    def block_fn(h, block):
+        return (
+            GPT.block_apply(config, block, h, key=None, inference=True, rope=rope),
+            None,
+        )
+
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn, policy=_remat_policy(config.remat_policy))
+    h, _ = jax.lax.scan(block_fn, x, stage_blocks, unroll=config.scan_unroll)
+    return h
+
+
+def make_pipeline_loss(
+    model_cfg: GPTConfig,
+    mesh: Mesh,
+    param_specs,
+    loss_chunk_tokens: int,
+    loss_remat_chunks: tp.Optional[bool] = None,
+    microbatches: int = 0,
+) -> tp.Callable:
+    """Build loss_fn(params, x, y, key) -> scalar running the GPipe schedule.
+
+    Drop-in replacement for the GSPMD loss in make_train_step (same contract
+    as make_shard_map_loss): GLOBAL (B, T) arrays in, global-mean scalar
+    out, differentiable. `key` is accepted for interface parity but unused
+    (pp requires dropout 0, enforced at config construction)."""
+    pp = mesh.shape["pp"]
+    M = microbatches or pp
+
+    def local_loss(params: GPTParams, x: Array, y: Array, key) -> Array:
+        del key  # dropout 0 under pp (config validation)
+        B, T = x.shape
+        if B % M != 0:
+            raise ValueError(
+                f"per-data-shard batch {B} not divisible by "
+                f"pipeline_microbatches={M} — lower pipeline_microbatches or "
+                "raise batch_size (config-time validation can only check the "
+                "global batch; this is the per-shard constraint)"
+            )
+        Bm = B // M
+        s = jax.lax.axis_index("pp")
+        rope = rope_table(model_cfg.head_dim, T)
+
+        # Embedding on every stage (replicated compute); only stage 0's
+        # result enters the pipeline, so only stage 0 contributes wte grad
+        # (shard_map's replicated-input transpose psums over 'pp').
+        h = jnp.take(params.wte, x, axis=0)  # (B, T, D)
+        x_mb = h.reshape(M, Bm, T, model_cfg.n_embd)
+
+        n_ticks = M + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        stage_fn = functools.partial(
+            gpipe_stage_apply, model_cfg, params.blocks, rope=rope
+        )
+
+        def tick(carry, t):
+            recv, outs = carry
+            mb = t - s  # microbatch index this stage serves at tick t
+            inp = jnp.where(
+                s == 0,
+                jax.lax.dynamic_index_in_dim(
+                    x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+                ),
+                recv,
+            )
+            out = stage_fn(inp)
+            collect = (s == pp - 1) & (mb >= 0) & (mb < M)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, out.astype(outs.dtype), jnp.clip(mb, 0, M - 1), 0
+            )
+            outs = jnp.where(collect, upd, outs)
+            send = jax.lax.ppermute(out, "pp", perm)
+            return (send, outs), None
+
+        init = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
+        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+
+        # Final norm + fused CE on the last stage's collected outputs; the
+        # masked psum broadcasts the scalar to all stages. Other stages'
+        # outs are zeros — their loss value is discarded by the mask, and
+        # its cotangent is zero, so no garbage gradients flow.
+        hidden = rms_norm(outs.reshape(B, T, model_cfg.n_embd), eps=1e-5)
+        loss = fused_linear_cross_entropy(
+            hidden, params.lm_head, y, loss_chunk_tokens, loss_remat_chunks
+        )
+        loss = jnp.where(s == pp - 1, loss, 0.0)
+        loss = jax.lax.psum(loss, "pp")
+        # global mean over the batch axes
+        return jax.lax.pmean(loss, BATCH_AXES)
+
+    batch_spec = P(BATCH_AXES, None)
+    return jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(param_specs, batch_spec, batch_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )

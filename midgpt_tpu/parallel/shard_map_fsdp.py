@@ -39,10 +39,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.models.gpt import GPT, GPTParams
 from midgpt_tpu.ops.loss import fused_linear_cross_entropy
+from midgpt_tpu.parallel.mesh import BATCH_AXES
 
 Array = jax.Array
-
-BATCH_AXES = ("data", "fsdp")
 
 
 def _sharded_axis(spec: P) -> tp.Optional[int]:

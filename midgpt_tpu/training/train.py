@@ -85,7 +85,19 @@ def make_train_step(
             head_axis="tp" if mesh.shape["tp"] > 1 else None,
         )
 
-    if config.fsdp_mode == "shard_map":
+    if mesh.shape["pp"] > 1:
+        from midgpt_tpu.parallel.pipeline import make_pipeline_loss
+
+        _pp_loss = make_pipeline_loss(
+            model_cfg, mesh, param_specs, config.loss_chunk_tokens,
+            config.loss_remat_chunks,
+            microbatches=config.pipeline_microbatches,
+        )
+
+        def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
+            return _pp_loss(params_c, x, y, key)
+
+    elif config.fsdp_mode == "shard_map":
         from midgpt_tpu.parallel.shard_map_fsdp import make_shard_map_loss
 
         _sm_loss = make_shard_map_loss(
@@ -147,13 +159,22 @@ def make_train_step(
         params = constrain(params, param_specs, mesh)
         return params, opt_state, loss
 
-    @jax.jit
-    def eval_loss(params: GPTParams, x: Array, y: Array) -> Array:
-        params_c = cast_compute(params)
+    def _eval_loss_one(params_c: GPTParams, x: Array, y: Array) -> Array:
+        if mesh.shape["pp"] > 1:
+            # GSPMD cannot shard a scan over its length axis, so the dense
+            # backbone would all-gather the stage-sharded blocks; evaluate
+            # through the same GPipe schedule instead (dropout-free, so the
+            # train-mode loss IS the eval loss).
+            return loss_fn(params_c, x, y, None)
         h = GPT.hidden(model_cfg, params_c, x, inference=True, attn_fn=attn_fn)
         return fused_linear_cross_entropy(
-            h, params_c.lm_head, y, config.loss_chunk_tokens
+            h, params_c.lm_head, y, config.loss_chunk_tokens,
+            config.loss_remat_chunks,
         )
+
+    @jax.jit
+    def eval_loss(params: GPTParams, x: Array, y: Array) -> Array:
+        return _eval_loss_one(cast_compute(params), x, y)
 
     @jax.jit
     def eval_loss_many(params: GPTParams, x_NBT: Array, y_NBT: Array) -> Array:
@@ -168,15 +189,7 @@ def make_train_step(
 
         def body(total, xy):
             x, y = xy
-            h = GPT.hidden(model_cfg, params_c, x, inference=True, attn_fn=attn_fn)
-            return (
-                total
-                + fused_linear_cross_entropy(
-                    h, params_c.lm_head, y, config.loss_chunk_tokens,
-                config.loss_remat_chunks,
-                ),
-                None,
-            )
+            return total + _eval_loss_one(params_c, x, y), None
 
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (x_NBT, y_NBT))
         return total
@@ -192,11 +205,20 @@ def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp
     abstract_params = jax.eval_shape(
         lambda k: GPT.init(config.model_config, k), jax.random.PRNGKey(0)
     )
-    # Spec rule: Megatron tp x fsdp (parallel/tp.py) — with mesh tp=1 it
-    # reduces to the plain FSDP rule exactly (pinned by test_tp.py).
-    from midgpt_tpu.parallel.tp import tp_param_specs
+    # Spec rule: GPipe layer-axis sharding when the mesh has a real 'pp'
+    # axis (parallel/pipeline.py), else Megatron tp x fsdp (parallel/tp.py)
+    # — which with mesh tp=1 reduces to the plain FSDP rule exactly (pinned
+    # by test_tp.py).
+    if mesh.shape["pp"] > 1:
+        from midgpt_tpu.parallel.pipeline import pipeline_param_specs
 
-    spec_rule = functools.partial(tp_param_specs, vocab_parallel=config.tp_vocab)
+        def spec_rule(tree, *_args):
+            return pipeline_param_specs(tree)
+
+    else:
+        from midgpt_tpu.parallel.tp import tp_param_specs
+
+        spec_rule = functools.partial(tp_param_specs, vocab_parallel=config.tp_vocab)
     param_specs = spec_rule(
         abstract_params, mesh, config.shard_model, config.fsdp_min_size
     )

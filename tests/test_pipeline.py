@@ -1,0 +1,155 @@
+"""GPipe pipeline parallelism (parallel/pipeline.py) on the virtual mesh:
+spec placement, loss/grad parity vs the dense model, and full-train-step
+trajectory parity vs the FSDP oracle. Beyond the reference's capability set
+(its only model sharding is FSDP, reference model.py:167-178)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.ops.loss import fused_linear_cross_entropy
+from midgpt_tpu.parallel.data import make_global_batch
+from midgpt_tpu.parallel.fsdp import constrain
+from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
+from midgpt_tpu.parallel.pipeline import make_pipeline_loss, pipeline_param_specs
+from midgpt_tpu.training.train import init_state, make_train_step
+
+CFG = GPTConfig(block_size=32, vocab_size=128, n_layer=4, n_head=2, n_embd=64)
+
+
+def _dense_loss(params, x, y):
+    h = GPT.hidden(CFG, params, x, inference=True)
+    return fused_linear_cross_entropy(h, params.lm_head, y, 8192)
+
+
+def test_pipeline_param_specs():
+    params = GPT.init(CFG, jax.random.PRNGKey(0))
+    specs = pipeline_param_specs(params)
+    assert specs.blocks.attn.wqkv == P("pp", None, None, None)
+    assert specs.blocks.mlp.w_up == P("pp", None, None)
+    assert specs.blocks.attn.q_scale == P("pp", None)
+    assert specs.wte == P()
+    assert specs.lm_head == P()
+    opt_like = {"mu": params, "count": jnp.zeros(())}
+    opt_specs = pipeline_param_specs(opt_like)
+    assert opt_specs["mu"].blocks.attn.wqkv == P("pp", None, None, None)
+    assert opt_specs["count"] == P()
+
+
+@pytest.mark.parametrize("pp,microbatches", [(2, 2), (4, 4), (4, 8)])
+def test_pipeline_loss_matches_dense(pp, microbatches):
+    mesh = make_mesh(MeshConfig(data=8 // pp, fsdp=1, sp=1, tp=1, pp=pp))
+    params = GPT.init(CFG, jax.random.PRNGKey(0))
+    specs = pipeline_param_specs(params)
+    sharded = jax.jit(lambda p: constrain(p, specs, mesh))(params)
+    rng = np.random.default_rng(0)
+    # per-data-shard batch must divide into M microbatches
+    B = (8 // pp) * microbatches
+    x = rng.integers(0, CFG.vocab_size, (B, 32), dtype=np.int32)
+    y = np.roll(x, -1, axis=-1)
+    xg = make_global_batch(x, mesh, batch_spec(with_accum=False))
+    yg = make_global_batch(y, mesh, batch_spec(with_accum=False))
+
+    pipe_loss = make_pipeline_loss(CFG, mesh, specs, 8192, microbatches=microbatches)
+    got = jax.jit(lambda p, a, b: pipe_loss(p, a, b, None))(sharded, xg, yg)
+    want = _dense_loss(params, jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_pipeline_gradients_match_dense():
+    """Reverse AD through the tick scan + ppermute (the GPipe backward
+    schedule) must equal dense-model gradients — including the replicated
+    wte/lm_head grads that shard_map's transpose psums across stages."""
+    pp = 4
+    mesh = make_mesh(MeshConfig(data=8 // pp, fsdp=1, sp=1, tp=1, pp=pp))
+    params = GPT.init(CFG, jax.random.PRNGKey(0))
+    specs = pipeline_param_specs(params)
+    sharded = jax.jit(lambda p: constrain(p, specs, mesh))(params)
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, CFG.vocab_size, (8, 32), dtype=np.int32)
+    y = np.roll(x, -1, axis=-1)
+    xg = make_global_batch(x, mesh, batch_spec(with_accum=False))
+    yg = make_global_batch(y, mesh, batch_spec(with_accum=False))
+
+    pipe_loss = make_pipeline_loss(CFG, mesh, specs, 8192)
+    g_pipe = jax.jit(jax.grad(lambda p, a, b: pipe_loss(p, a, b, None)))(sharded, xg, yg)
+    g_dense = jax.grad(_dense_loss)(params, jnp.asarray(x), jnp.asarray(y))
+    for gp, gd in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_dense)):
+        np.testing.assert_allclose(
+            np.asarray(gp), np.asarray(gd), atol=3e-5, rtol=3e-5
+        )
+
+
+def test_pipeline_train_step_matches_fsdp_only():
+    """One full training step on a (data=2, pp=4) mesh reproduces the
+    FSDP-only oracle's loss on the same batch and seed."""
+    base = dict(
+        rundir="",
+        data_dir="",
+        learning_rate=1e-2,
+        batch_size=8,
+        warmup_steps=5,
+        min_lr=1e-3,
+        lr_decay_steps=50,
+        max_steps=50,
+        beta2=0.99,
+        weight_decay=1e-4,
+        eval_interval=25,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=2,
+        shard_model=True,
+        fsdp_min_size=0,
+        eval_steps=2,
+        model_config=CFG,
+    )
+    oracle_cfg = ExperimentConfig(mesh=MeshConfig(data=2, fsdp=4, sp=1), **base)
+    pp_cfg = ExperimentConfig(
+        mesh=MeshConfig(data=2, fsdp=1, sp=1, tp=1, pp=4), **base
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, CFG.vocab_size, (2, 8, 32), dtype=np.int32)
+    y = np.roll(x, -1, axis=-1)
+    losses = {}
+    evals = {}
+    for name, cfg in (("oracle", oracle_cfg), ("pp", pp_cfg)):
+        mesh = make_mesh(cfg.mesh)
+        params, opt_state, specs, optimizer = init_state(cfg, mesh)
+        step, eval_loss, _ = make_train_step(cfg, optimizer, mesh, specs)
+        xg = make_global_batch(x, mesh, batch_spec())
+        yg = make_global_batch(y, mesh, batch_spec())
+        params, _, loss = step(params, opt_state, xg, yg, jax.random.PRNGKey(0))
+        losses[name] = float(loss)
+        evals[name] = float(eval_loss(params, xg[0], yg[0]))
+    np.testing.assert_allclose(losses["pp"], losses["oracle"], rtol=1e-5)
+    np.testing.assert_allclose(evals["pp"], evals["oracle"], rtol=1e-5)
+
+
+def test_pipeline_config_validation():
+    kw = dict(
+        rundir="", data_dir="", learning_rate=1e-3, batch_size=8, warmup_steps=1,
+        min_lr=1e-4, lr_decay_steps=10, max_steps=10, beta2=0.99, weight_decay=0.0,
+        eval_interval=5, param_dtype="float32", compute_dtype="float32",
+        g_accum_iters=1, shard_model=True,
+    )
+    with pytest.raises(ValueError, match="n_layer"):
+        ExperimentConfig(
+            mesh=MeshConfig(fsdp=1, pp=3),
+            model_config=CFG,  # n_layer=4 % 3 != 0
+            **kw,
+        )
+    with pytest.raises(ValueError, match="dropout"):
+        ExperimentConfig(
+            mesh=MeshConfig(fsdp=1, pp=2),
+            model_config=dataclasses.replace(CFG, dropout=0.1),
+            **kw,
+        )
+    with pytest.raises(ValueError, match="composes"):
+        ExperimentConfig(mesh=MeshConfig(fsdp=2, pp=2), model_config=CFG, **kw)
