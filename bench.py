@@ -26,7 +26,12 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--batch", type=int, default=16,
+                        help="per-microbatch per-device batch size")
+    parser.add_argument("--accum", type=int, default=1,
+                        help="g_accum_iters: microbatches per step (the "
+                        "production 124M recipe uses 16 — reference "
+                        "configs/openwebtext.py:18)")
     parser.add_argument("--attn", type=str, default=None, choices=[None, "naive", "flash", "blockwise"])
     parser.add_argument("--remat", type=str, default="off",
                         choices=["off", "none", "dots", "dots_attn", "flash"],
@@ -79,7 +84,7 @@ def main() -> int:
     config = base_config.replace(
         **({"loss_chunk_tokens": args.loss_chunk} if args.loss_chunk else {}),
         batch_size=args.batch * n_dev,
-        g_accum_iters=1,
+        g_accum_iters=args.accum,
         shard_model=n_dev > 1,
         mesh=MeshConfig(data=1, fsdp=n_dev, sp=1),
         model_config=model_cfg,
@@ -93,7 +98,7 @@ def main() -> int:
     T = model_cfg.block_size
     B = config.batch_size
     rng = np.random.default_rng(0)
-    x = rng.integers(0, model_cfg.vocab_size, (1, B, T), dtype=np.int32)
+    x = rng.integers(0, model_cfg.vocab_size, (args.accum, B, T), dtype=np.int32)
     y = np.roll(x, -1, axis=-1)
     xg = make_global_batch(x, mesh, batch_spec())
     yg = make_global_batch(y, mesh, batch_spec())
@@ -117,14 +122,15 @@ def main() -> int:
     if args.profile:
         jax.profiler.stop_trace()
 
-    tokens_per_sec = args.steps * B * T / dt
+    tokens_per_sec = args.steps * args.accum * B * T / dt
     fpt = flops_per_token(model_cfg)
     peak = device_peak_flops()
     achieved = tokens_per_sec * fpt / n_dev
     mfu = achieved / peak if peak else None
 
     result = {
-        "metric": f"train_mfu_{args.shape}_{attn}_{jax.devices()[0].platform}",
+        "metric": f"train_mfu_{args.shape}_{attn}_{jax.devices()[0].platform}"
+        + (f"_accum{args.accum}" if args.accum > 1 else ""),
         "value": round(mfu * 100, 2) if mfu is not None else round(tokens_per_sec, 0),
         "unit": "% MFU" if mfu is not None else "tokens/sec",
         "vs_baseline": round(mfu / BASELINE_MFU, 3) if mfu is not None else None,
@@ -132,6 +138,7 @@ def main() -> int:
             "tokens_per_sec": round(tokens_per_sec, 0),
             "step_ms": round(1000 * dt / args.steps, 2),
             "batch": B,
+            "g_accum_iters": args.accum,
             "seq_len": T,
             "n_devices": n_dev,
             "device": getattr(jax.devices()[0], "device_kind", "?"),

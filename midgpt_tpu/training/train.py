@@ -5,8 +5,9 @@ val-loss parity:
   * fp32 master params, cast to the compute dtype (bf16) once per step;
   * `lax.scan` over `g_accum_iters` microbatches, each microgradient
     re-constrained to the FSDP layout (so accumulation happens *sharded* —
-    GSPMD reduce-scatters each microstep, reference train.py:87) and summed
-    into an fp32 accumulator; summed loss averaged, grads divided by G;
+    GSPMD reduce-scatters each microstep, reference train.py:87) and
+    accumulated in fp32 pre-scaled by 1/G (no epilogue divide); losses
+    averaged on the scalar;
   * optax update + apply, params re-constrained, buffers donated.
 
 The whole step — microbatching, collectives, optimizer — is ONE XLA program
@@ -141,18 +142,27 @@ def make_train_step(
             grad = jax.tree.map(lambda g, p: g.astype(p.dtype), grad, params)
         else:
 
+            # The /G rides each accumulate as a fused elementwise scale, so
+            # the epilogue divide's parameter-sized read+write sweep
+            # disappears. (Measured: the whole accumulation machinery is
+            # ~3 ms of a 2.2 s G=16 step at 124M — RESULTS.md §1 — so no
+            # first-microstep peel: it would double the compiled graph for
+            # a win within noise.) Math is the reference's sharded-fp32
+            # accumulation (reference train.py:85-94) up to f32
+            # reassociation of the mean.
+            inv_G = 1.0 / G
+
             def microstep(grad_acc, xyk):
                 x, y, k = xyk
                 loss, grad = jax.value_and_grad(loss_fn)(params_c, x, y, k)
                 grad = constrain(grad, param_specs, mesh)
                 grad_acc = jax.tree.map(
-                    lambda a, g: a + g.astype(a.dtype), grad_acc, grad
+                    lambda a, g: a + g.astype(a.dtype) * inv_G, grad_acc, grad
                 )
                 return grad_acc, loss
 
             grad_init = jax.tree.map(jnp.zeros_like, params)
             grad, losses = jax.lax.scan(microstep, grad_init, (x_GBT, y_GBT, keys))
-            grad = jax.tree.map(lambda g: g / G, grad)
             loss = jnp.mean(losses)
         updates, opt_state = optimizer.update(grad, opt_state, params)
         params = optax.apply_updates(params, updates)
