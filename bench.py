@@ -26,8 +26,10 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--batch", type=int, default=16,
-                        help="per-microbatch per-device batch size")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="per-microbatch per-device batch size (default: "
+                        "the shape config's measured optimum — 16 for 124m, "
+                        "12 for wide)")
     parser.add_argument("--accum", type=int, default=1,
                         help="g_accum_iters: microbatches per step (the "
                         "production 124M recipe uses 16 — reference "
@@ -51,7 +53,15 @@ def main() -> int:
     args = parser.parse_args()
 
     from midgpt_tpu.config import MeshConfig
-    from midgpt_tpu.configs.openwebtext import config as base_config
+
+    # One source of truth per shape: '124m' is the openwebtext recipe
+    # (reference configs/openwebtext.py), 'wide' is the shipped
+    # configs/wide610m.py — the same file launch.py trains, so the bench
+    # number is reproducible through the normal CLI too.
+    if args.shape == "wide":
+        from midgpt_tpu.configs.wide610m import config as base_config
+    else:
+        from midgpt_tpu.configs.openwebtext import config as base_config
     from midgpt_tpu.models.gpt import GPT
     from midgpt_tpu.parallel.data import make_global_batch
     from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
@@ -65,12 +75,13 @@ def main() -> int:
     attn = args.attn or ("flash" if jax.default_backend() == "tpu" else "naive")
     import dataclasses
 
-    # GPT-2-XL-shaped wide-head slice: C=128 fills the 128-lane MXU on
-    # QK^T/PV (C=64 runs it half-utilized — docs/ROADMAP.md), depth trimmed
-    # so fp32 master + Adam state + activations fit one chip's 15.75 GB.
-    shape_overrides = {"n_embd": 2048, "n_head": 16, "n_layer": 8} if args.shape == "wide" else {}
-    if args.layers:
-        shape_overrides["n_layer"] = args.layers
+    shape_overrides = {"n_layer": args.layers} if args.layers else {}
+    # wide610m is a single-chip config, so its batch_size IS the per-device
+    # optimum; the 124m shape keeps the bench's historical default (the
+    # openwebtext preset's global batch is a multi-chip recipe value).
+    per_dev_batch = args.batch or (
+        base_config.batch_size if args.shape == "wide" else 16
+    )
     model_cfg = dataclasses.replace(
         model_cfg,
         **shape_overrides,
@@ -83,7 +94,7 @@ def main() -> int:
     )
     config = base_config.replace(
         **({"loss_chunk_tokens": args.loss_chunk} if args.loss_chunk else {}),
-        batch_size=args.batch * n_dev,
+        batch_size=per_dev_batch * n_dev,
         g_accum_iters=args.accum,
         shard_model=n_dev > 1,
         mesh=MeshConfig(data=1, fsdp=n_dev, sp=1),
