@@ -114,14 +114,20 @@ def main() -> None:
     val_docs = [docs[i] for i in order[:n_val]]
     train_docs = [docs[i] for i in order[n_val:]]
 
-    trainer_docs = docs
+    # BPE merges are learned from the TRAIN split only — val tokens must not
+    # leak into the vocabulary statistics (mild train/val contamination
+    # otherwise; the reference's GPT-2 vocab is likewise fixed independently
+    # of its val split).
+    trainer_docs = train_docs
     if args.train_sample_mb > 0:
         budget = int(args.train_sample_mb * 1e6)
-        sample_order = np.random.default_rng(SPLIT_SEED + 1).permutation(len(docs))
+        sample_order = np.random.default_rng(SPLIT_SEED + 1).permutation(
+            len(train_docs)
+        )
         trainer_docs, used = [], 0
         for i in sample_order:
-            trainer_docs.append(docs[i])
-            used += len(docs[i])
+            trainer_docs.append(train_docs[i])
+            used += len(train_docs[i])
             if used >= budget:
                 break
         print(f"BPE trainer sample: {len(trainer_docs):,} docs, {used:,} chars")
@@ -139,14 +145,29 @@ def main() -> None:
     assert vocab_size <= np.iinfo(np.uint16).max, "uint16 stream format"
     print(f"trained BPE: vocab {vocab_size}, eot id {eot_id} -> {tok_path}")
 
+    counts = {}
     for name, split in (("train", train_docs), ("val", val_docs)):
         path = os.path.join(args.out_dir, f"{name}.bin")
-        total = encode_to_bin(tokenizer, split, eot_id, path)
-        print(f"{name}: {total:,} tokens -> {path}")
+        counts[name] = encode_to_bin(tokenizer, split, eot_id, path)
+        print(f"{name}: {counts[name]:,} tokens -> {path}")
 
+    with open(tok_path, "rb") as f:
+        tok_sha = hashlib.sha256(f.read()).hexdigest()
     with open(os.path.join(args.out_dir, "meta.pkl"), "wb") as f:
+        # Staleness fingerprint: bins, tokenizer and meta are only coherent
+        # as a set from ONE prepare run. The token counts let TokenDataset
+        # detect bins from an older run (e.g. tracked tokenizer.json updated
+        # by git while untracked *.bin stayed behind) and fail loudly
+        # instead of training on re-interpreted ids.
         pickle.dump(
-            {"kind": "hf_bpe", "tokenizer_file": "tokenizer.json", "vocab_size": vocab_size}, f
+            {
+                "kind": "hf_bpe",
+                "tokenizer_file": "tokenizer.json",
+                "vocab_size": vocab_size,
+                "tokenizer_sha256": tok_sha,
+                "split_tokens": counts,
+            },
+            f,
         )
 
 

@@ -166,11 +166,6 @@ class ExperimentConfig:
                     f"attn_impl='ulysses' needs n_head % (tp*sp) == 0, got "
                     f"n_head={mc.n_head}, tp={tp}, sp={sp}"
                 )
-            if self.fsdp_mode == "shard_map":
-                raise ValueError(
-                    "attn_impl='ulysses' composes only with fsdp_mode='gspmd' "
-                    "(the shard_map body wires the ring)"
-                )
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -87,9 +87,19 @@ class TokenDataset:
         self.data_dir = data_dir
         self.seed = seed
         self.splits: tp.Dict[str, np.ndarray] = {}
+        # Prep pipelines that retrain their tokenizer (data/local_text)
+        # fingerprint the bins in meta.pkl; bins left behind from an older
+        # prepare run would otherwise train silently on re-interpreted ids.
+        expected = (self.meta() or {}).get("split_tokens", {})
         for split in ("train", "val"):
             path = os.path.join(data_dir, f"{split}.bin")
             arr = np.memmap(path, dtype=np.uint16, mode="r")
+            if expected.get(split, len(arr)) != len(arr):
+                raise ValueError(
+                    f"{path} has {len(arr):,} tokens but meta.pkl records "
+                    f"{expected[split]:,} — the bins predate the committed "
+                    "tokenizer/meta. Re-run the dataset's prepare.py."
+                )
             if shard_by_process:
                 import jax
 

@@ -101,11 +101,13 @@ def blockwise_causal_attention(
             ).astype(jnp.float32)
             return (acc_new, m_new, denom_new), None
 
-        init = (
-            jnp.zeros((B, H, blk, C), jnp.float32),
-            jnp.full((B, H, blk), NEG_INF, jnp.float32),
-            jnp.zeros((B, H, blk), jnp.float32),
-        )
+        # Derive the init from q_i (not fresh constants) so that inside an
+        # enclosing shard_map the carry inherits q's varying-manual-axes
+        # annotation — a constant init trips scan's carry-type check there
+        # (the Ulysses-inside-ZeRO-3 composition hits exactly this).
+        zeros_c = (q_i * 0).astype(jnp.float32)  # (B, H, blk, C)
+        zeros_r = jnp.sum(zeros_c, axis=-1)  # (B, H, blk)
+        init = (zeros_c, zeros_r + NEG_INF, zeros_r)
         (acc, _, denom), _ = jax.lax.scan(kv_step, init, jnp.arange(n_blk))
         # max() guards fully-masked (padded) query rows against 0/0 NaN.
         return (acc / jnp.maximum(denom, 1e-30)[..., None]).astype(q.dtype)

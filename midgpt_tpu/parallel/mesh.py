@@ -1,4 +1,4 @@
-"""Device mesh construction: a named 4D ('data', 'fsdp', 'sp', 'tp') mesh.
+"""Device mesh construction: a named 5D ('data','fsdp','sp','tp','pp') mesh.
 
 The reference hard-codes Mesh((n_devices // 8, 8), ('replica', 'data')) —
 batch over both axes, params over the 8-wide axis (reference train.py:130),
@@ -6,9 +6,10 @@ which requires device counts divisible by 8. Here axis sizes come from config
 with -1 inference, `mesh_utils.create_device_mesh` picks the physical layout
 so 'fsdp' collectives (the per-layer all-gathers/reduce-scatters) ride
 contiguous ICI links, 'sp' is the context-parallel axis (ring or Ulysses
-attention), and 'tp' is the tensor-parallel axis (Megatron column/row
-sharding of the block projections, parallel/tp.py) — both size 1 unless
-enabled.
+attention), 'tp' is the tensor-parallel axis (Megatron column/row sharding
+of the block projections, parallel/tp.py), and 'pp' is the pipeline axis
+(GPipe stages shard the LAYER dimension, parallel/pipeline.py) — all three
+size 1 unless enabled.
 """
 
 from __future__ import annotations

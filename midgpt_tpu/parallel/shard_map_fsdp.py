@@ -70,7 +70,7 @@ def make_shard_map_loss(
     param_specs,
     loss_chunk_tokens: int,
     loss_remat_chunks: tp.Optional[bool] = None,
-    sequence_parallel: bool = False,
+    sequence_parallel: tp.Optional[str] = None,
 ) -> tp.Callable:
     """Build loss_fn(params, x, y, key) -> scalar with authored collectives.
 
@@ -78,13 +78,16 @@ def make_shard_map_loss(
     arrays, returns the global-mean loss; differentiable (grads come back in
     the params' sharded layout).
 
-    With `sequence_parallel` the T axis of the batch is additionally sharded
-    over the mesh's 'sp' axis and attention runs the ring
-    (parallel/ring_attention.py) — the ZeRO-3 schedule and the ring compose
-    inside ONE shard_map body: per-layer weight all-gathers ride the 'fsdp'
-    axis while K/V shards rotate on 'sp', with no nesting. Everything else
-    in the backbone is token-pointwise, needing only shard-aware RoPE
+    `sequence_parallel` ('ring' | 'ulysses' | None) additionally shards the
+    batch's T axis over the mesh's 'sp' axis and runs the named
+    context-parallel attention schedule — ZeRO-3 and SP compose inside ONE
+    shard_map body: per-layer weight all-gathers ride the 'fsdp' axis while
+    the attention collectives ride 'sp' (K/V ppermute rotation for the ring,
+    head<->sequence all_to_all for Ulysses), with no nesting. Everything
+    else in the backbone is token-pointwise, needing only shard-aware RoPE
     positions (GPT.hidden positions/rope_len)."""
+    if sequence_parallel not in (None, "ring", "ulysses"):
+        raise ValueError(f"unknown sequence_parallel {sequence_parallel!r}")
     block_specs = jax.tree.map(_drop_leading, param_specs.blocks)
 
     def gather_block(block):
@@ -103,12 +106,21 @@ def make_shard_map_loss(
         )
         positions = rope_len = attn_fn = None
         if sequence_parallel:
-            from midgpt_tpu.parallel.ring_attention import ring_attention
-
             Tl = x.shape[1]
             rope_len = Tl * jax.lax.axis_size("sp")
             positions = jax.lax.axis_index("sp") * Tl + jnp.arange(Tl)
-            attn_fn = lambda q, k, v: ring_attention(q, k, v, "sp")
+            if sequence_parallel == "ring":
+                from midgpt_tpu.parallel.ring_attention import ring_attention
+
+                attn_fn = lambda q, k, v: ring_attention(q, k, v, "sp")
+            else:
+                from midgpt_tpu.parallel.ulysses import ulysses_attention
+
+                attn_fn = lambda q, k, v: ulysses_attention(
+                    q, k, v, "sp",
+                    block_size=model_cfg.attn_block_size,
+                    impl="flash",
+                )
         h = GPT.hidden(
             model_cfg,
             gathered,

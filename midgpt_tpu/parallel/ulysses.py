@@ -56,14 +56,25 @@ def ulysses_attention(
     T axis over `axis_name` produces); heads must divide the axis size."""
     n = jax.lax.axis_size(axis_name)
     if n > 1:
-        assert q.shape[1] % n == 0, (
-            f"n_head={q.shape[1]} not divisible by {axis_name} size {n}"
-        )
+        if q.shape[1] % n != 0:
+            # ValueError (not assert): direct callers bypass the
+            # ExperimentConfig validation, and `python -O` strips asserts —
+            # the failure would otherwise surface as an opaque all_to_all
+            # shape error.
+            raise ValueError(
+                f"n_head={q.shape[1]} not divisible by {axis_name} size {n}"
+            )
         # trade sequence sharding for head sharding: (B, H/n, T, C)
         q, k, v = (
             jax.lax.all_to_all(a, axis_name, split_axis=1, concat_axis=2, tiled=True)
             for a in (q, k, v)
         )
+    # inference=True here only disables dropout inside the dispatcher — and
+    # no dropout can ever reach this path: the fused impls define none
+    # (ops/attention.py raises NotImplementedError), GPT._attention refuses
+    # to inject an attn_fn when training with dropout>0, and config
+    # validation rejects attn_impl='ulysses' + dropout up front. Three
+    # guards, so this flag is not load-bearing for train/eval semantics.
     out = multihead_attention(
         q, k, v, impl=impl, inference=True, block_size=block_size, layout="bhtc"
     )

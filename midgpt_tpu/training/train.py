@@ -104,7 +104,11 @@ def make_train_step(
         _sm_loss = make_shard_map_loss(
             model_cfg, mesh, param_specs, config.loss_chunk_tokens,
             config.loss_remat_chunks,
-            sequence_parallel=model_cfg.attn_impl == "ring",
+            sequence_parallel=(
+                model_cfg.attn_impl
+                if model_cfg.attn_impl in ("ring", "ulysses")
+                else None
+            ),
         )
 
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:

@@ -73,3 +73,36 @@ def test_end_to_end_pipeline_round_trip(corpus, tmp_path):
     # tokens decode back to text containing the source material
     text = tok.decode(train.tolist(), skip_special_tokens=True)
     assert "add" in text
+
+
+def test_meta_fingerprint_detects_stale_bins(corpus, tmp_path):
+    """meta.pkl records per-split token counts + tokenizer hash; TokenDataset
+    refuses bins whose length disagrees (e.g. tracked tokenizer.json/meta.pkl
+    updated by git while the untracked bins stayed behind)."""
+    out = tmp_path / "out"
+    res = subprocess.run(
+        [
+            sys.executable, prep.__file__,
+            "--roots", str(corpus),
+            "--out-dir", str(out),
+            "--vocab-size", "400",
+            "--val-fraction", "0.5",
+        ],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stderr
+    with open(out / "meta.pkl", "rb") as f:
+        meta = pickle.load(f)
+    assert set(meta["split_tokens"]) == {"train", "val"}
+    assert len(meta["tokenizer_sha256"]) == 64
+
+    from midgpt_tpu.data.dataset import TokenDataset
+
+    ds = TokenDataset(str(out))  # coherent set loads fine
+    assert len(ds["train"]) == meta["split_tokens"]["train"]
+
+    # simulate stale bins: truncate train.bin after meta was written
+    tokens = np.fromfile(out / "train.bin", dtype=np.uint16)
+    tokens[: tokens.size // 2].tofile(out / "train.bin")
+    with pytest.raises(ValueError, match="prepare.py"):
+        TokenDataset(str(out))

@@ -146,7 +146,7 @@ def test_loss_and_grads_match_gspmd_with_ring():
         h = GPT.hidden(oracle_cfg, p, x, inference=True)
         return fused_linear_cross_entropy(h, p.lm_head, y, CHUNK)
 
-    sm_loss = make_shard_map_loss(cfg, mesh, specs, CHUNK, sequence_parallel=True)
+    sm_loss = make_shard_map_loss(cfg, mesh, specs, CHUNK, sequence_parallel="ring")
 
     ref_l, ref_g = jax.jit(jax.value_and_grad(gspmd_loss))(params, xg, yg)
     sm_l, sm_g = jax.jit(
