@@ -14,8 +14,12 @@ flat uint16 + `meta.pkl`) from purely local files:
      split discipline (shuffle seed 2357; reference data/openwebtext/
      prepare.py:21-30 uses the same fraction/seed on HF splits)
 
-`meta.pkl` records {"kind": "hf_bpe", "tokenizer_file", "vocab_size"} so
-sample.py round-trips text through the trained tokenizer.
+`meta.pkl` records {"kind": "hf_bpe", "tokenizer_file", "vocab_size",
+"tokenizer_sha256", "split_tokens"}: the codec pointer for sample.py plus a
+staleness fingerprint — TokenDataset refuses bins whose token counts
+disagree with `split_tokens`, and sample.py refuses a tokenizer.json whose
+hash disagrees with `tokenizer_sha256` (bins/tokenizer/meta are only
+coherent as a set from one prepare run).
 
 Usage:
     python data/local_text/prepare.py --roots DIR [DIR ...] [--vocab-size N]

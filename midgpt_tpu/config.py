@@ -130,19 +130,19 @@ class ExperimentConfig:
         if self.pipeline_microbatches < 0:
             raise ValueError(f"pipeline_microbatches={self.pipeline_microbatches} must be >= 0")
         if pp > 1:
-            # v1 GPipe composes with data parallelism only (parallel/pipeline.py):
-            # stages shard the LAYER axis; fsdp/sp/tp sharding of the per-stage
-            # weights is future work.
+            # v2 GPipe composes with 'data' AND 'fsdp' (parallel/pipeline.py):
+            # stages shard the LAYER axis, stage weights can shard over
+            # 'fsdp'; sp/tp composition is future work.
             if mc.n_layer % pp != 0:
                 raise ValueError(f"n_layer={mc.n_layer} not divisible by mesh.pp={pp}")
             if mc.dropout != 0.0:
                 raise ValueError("mesh.pp > 1 requires dropout=0.0")
             if self.fsdp_mode != "gspmd":
                 raise ValueError("mesh.pp > 1 requires fsdp_mode='gspmd'")
-            if self.mesh.fsdp not in (1, -1) or self.mesh.sp not in (1, -1) or tp != 1:
+            if self.mesh.sp not in (1, -1) or tp != 1:
                 raise ValueError(
-                    "mesh.pp > 1 currently composes only with 'data' "
-                    "(set fsdp=1, sp=1, tp=1)"
+                    "mesh.pp > 1 currently composes with 'data' and 'fsdp' "
+                    "only (set sp=1, tp=1)"
                 )
             if mc.attn_impl in ("ring", "ulysses"):
                 raise ValueError("mesh.pp > 1 does not compose with sequence parallelism yet")

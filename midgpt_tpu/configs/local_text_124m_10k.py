@@ -1,0 +1,21 @@
+"""Flagship long run: the 124M openwebtext recipe, 10k steps on one chip.
+
+The r4 golden-loss artifact (docs/runs/local_text_124m_r4_10k/): the full
+openwebtext recipe shape and optimizer (reference configs/openwebtext.py:4-21)
+with the warmup/decay horizon scaled to 10,000 steps — ~2.62B training tokens
+(effective batch 256 × T=1024), ~11.5 epochs over the 228M-token offline-BPE
+local_text corpus — with a deliberate kill + `--rundir` resume mid-run as the
+recovery proof (reference README.md:29-33's resume flow, under test instead
+of in prose). Inherits the 3k config's fast path: flash attention, remat off,
+fused CE, G=16.
+"""
+
+from midgpt_tpu.configs.local_text_124m import config as _base
+
+config = _base.replace(
+    warmup_steps=300,
+    lr_decay_steps=10_000,
+    max_steps=10_000,
+    eval_interval=500,
+    eval_steps=50,
+)

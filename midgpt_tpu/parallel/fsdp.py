@@ -41,6 +41,29 @@ def _choose_axis(shape: tp.Tuple[int, ...], n_shards: int, skip_leading: bool) -
     return max(candidates, key=lambda ax: shape[ax])
 
 
+def fsdp_leaf_spec(
+    x,
+    n_shards: int,
+    shard_model: bool = True,
+    min_size: int = 2**18,
+    reserved_leading: int = 0,
+) -> tp.List[tp.Any]:
+    """THE per-leaf FSDP rule (single source — the pp spec rule reuses it):
+    size gate, then axis choice over the non-reserved axes. Returns a
+    mutable spec list so callers (pipeline_param_specs) can fill the
+    reserved leading slots before building the PartitionSpec."""
+    spec: tp.List[tp.Any] = [None] * x.ndim
+    if shard_model and n_shards > 1 and x.size > min_size:
+        ax = _choose_axis(
+            tuple(x.shape[reserved_leading:]),
+            n_shards,
+            skip_leading=reserved_leading == 0,
+        )
+        if ax is not None:
+            spec[ax + reserved_leading] = "fsdp"
+    return spec
+
+
 def fsdp_param_specs(
     params: tp.Any,
     mesh: Mesh,
@@ -51,14 +74,8 @@ def fsdp_param_specs(
     n_shards = mesh.shape["fsdp"]
 
     def rule(x) -> P:
-        if not shard_model or n_shards == 1 or x.size <= min_size:
-            return P()
-        ax = _choose_axis(tuple(x.shape), n_shards, skip_leading=True)
-        if ax is None:
-            return P()
-        spec: tp.List[tp.Any] = [None] * x.ndim
-        spec[ax] = "fsdp"
-        return P(*spec)
+        spec = fsdp_leaf_spec(x, n_shards, shard_model, min_size)
+        return P(*spec) if any(e is not None for e in spec) else P()
 
     return jax.tree.map(rule, params)
 

@@ -20,20 +20,33 @@ program every tick; there is no per-stage control flow to trace):
     its finished microbatches into an output buffer by a masked
     dynamic-index update (bubble ticks compute on garbage that is never
     collected — static shapes, no `lax.cond`);
-  * loss: the last stage runs final-norm + fused CE on its collected
-    outputs; a `psum` over 'pp' of the masked per-stage value broadcasts
-    the scalar. Reverse-mode AD through the tick scan + ppermute IS the
-    GPipe backward schedule (ppermute transposes to the reverse
-    permutation; the scan's saved residuals are the activation stash), and
-    shard_map's transpose of the replicated wte/lm_head inputs inserts the
-    psum that combines stage 0's embedding grad and the last stage's head
-    grad.
+  * loss (v2): the collected outputs are `psum_scatter`ed over 'pp' — only
+    the last stage's buffer is nonzero, so the scatter-sum is a
+    broadcast-slice handing stage s tokens [s·B/pp, (s+1)·B/pp) — and EVERY
+    stage runs final-norm + fused CE on its 1/pp slice; a `pmean` over 'pp'
+    recombines the mean. Total lm_head/CE matmul volume is 1×, not the v1
+    pp× (where each stage ran the full-batch CE on mostly-zero outputs).
+    Reverse-mode AD through the tick scan + ppermute IS the GPipe backward
+    schedule (ppermute transposes to the reverse permutation; the scan's
+    saved residuals are the activation stash; psum_scatter transposes to
+    all_gather), and shard_map's transpose of the pp-replicated wte/lm_head
+    inputs inserts the psum that combines stage 0's embedding grad and the
+    per-stage head grads.
+  * fsdp composition (v2): with a real 'fsdp' axis the batch additionally
+    shards over it (BATCH_AXES) and each stage's block leaves shard a
+    non-layer axis over 'fsdp' (pipeline_param_specs); the body all-gathers
+    each layer's weights inside the stage scan (ZeRO-3 streaming, same
+    authored collective as parallel/shard_map_fsdp.py — AD emits the
+    per-layer grad reduce-scatter as the gather's transpose).
 
 The pipeline bubble is the standard (pp-1)/(M+pp-1) fraction of ticks;
-`pipeline_microbatches` trades bubble against per-tick matmul size.
+`pipeline_microbatches` trades bubble against per-tick matmul size. A
+1F1B/interleaved schedule (smaller activation stash at equal bubble) is
+future work — the tick structure accommodates it, the collect logic is the
+part that would change.
 
-v1 composes with the 'data' axis (batch sharding); fsdp/sp/tp sharding of
-the per-stage weights is future work (config validation enforces this).
+v2 composes with 'data' AND 'fsdp'; sp/tp sharding of the per-stage weights
+is future work (config validation enforces this).
 """
 
 from __future__ import annotations
@@ -54,31 +67,47 @@ from midgpt_tpu.parallel.mesh import BATCH_AXES
 Array = jax.Array
 
 
-def pipeline_param_specs(params: tp.Any) -> tp.Any:
+def pipeline_param_specs(
+    params: tp.Any,
+    mesh: tp.Optional[Mesh] = None,
+    shard_model: bool = True,
+    min_size: int = 2**18,
+) -> tp.Any:
     """Specs for the GPipe schedule: block leaves shard their leading LAYER
-    axis over 'pp'; everything else replicated (v1 — see module docstring).
-    Works for params AND optimizer-state trees (path-keyed on 'blocks')."""
+    axis over 'pp'; with a real 'fsdp' mesh axis (and shard_model), large
+    leaves additionally shard a non-layer axis over 'fsdp' (the same
+    axis-choice rule as parallel/fsdp.py — exact divisibility required,
+    since shard_map hands the body literal shards). Works for params AND
+    optimizer-state trees (path-keyed on 'blocks')."""
+    from midgpt_tpu.parallel.fsdp import fsdp_leaf_spec
 
-    def rule_blocks(x) -> P:
-        spec: tp.List[tp.Any] = [None] * x.ndim
-        spec[0] = "pp"
-        return P(*spec)
+    n_fsdp = mesh.shape["fsdp"] if mesh is not None else 1
 
     def rule(path, x) -> P:
         names = [getattr(e, "name", None) or getattr(e, "key", None) for e in path]
         if "blocks" in names:
-            return rule_blocks(x)
-        return P()
+            # layer axis reserved for 'pp'; fsdp picks among the rest
+            spec = fsdp_leaf_spec(x, n_fsdp, shard_model, min_size, reserved_leading=1)
+            spec[0] = "pp"
+            return P(*spec)
+        spec = fsdp_leaf_spec(x, n_fsdp, shard_model, min_size)
+        return P(*spec) if any(e is not None for e in spec) else P()
 
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
 def gpipe_stage_apply(
-    config: GPTConfig, stage_blocks, x: Array, rope
+    config: GPTConfig, stage_blocks, x: Array, rope, layer_transform=None
 ) -> Array:
-    """Run this stage's (L/pp)-layer slice on one microbatch (Bm, T, D)."""
+    """Run this stage's (L/pp)-layer slice on one microbatch (Bm, T, D).
+
+    `layer_transform` (optional) maps a layer's sharded block leaves to full
+    ones — the fsdp all-gather hook; under remat the gather replays in the
+    backward instead of keeping gathered weights alive (ZeRO-3)."""
 
     def block_fn(h, block):
+        if layer_transform is not None:
+            block = layer_transform(block)
         return (
             GPT.block_apply(config, block, h, key=None, inference=True, rope=rope),
             None,
@@ -107,13 +136,22 @@ def make_pipeline_loss(
     pp = mesh.shape["pp"]
     M = microbatches or pp
 
+    # fsdp gather plumbing (shared helpers with the explicit ZeRO-3 module):
+    # per-layer block specs are the stacked specs minus the leading 'pp' axis.
+    from midgpt_tpu.parallel.shard_map_fsdp import _drop_leading, _gather_leaf
+
+    block_layer_specs = jax.tree.map(_drop_leading, param_specs.blocks)
+
+    def gather_block(block):
+        return jax.tree.map(_gather_leaf, block, block_layer_specs)
+
     def local_loss(params: GPTParams, x: Array, y: Array, key) -> Array:
         del key  # dropout 0 under pp (config validation)
         B, T = x.shape
-        if B % M != 0:
+        if B % M != 0 or B % pp != 0:
             raise ValueError(
-                f"per-data-shard batch {B} not divisible by "
-                f"pipeline_microbatches={M} — lower pipeline_microbatches or "
+                f"per-data-shard batch {B} must be divisible by both "
+                f"pipeline_microbatches={M} and pp={pp} — lower them or "
                 "raise batch_size (config-time validation can only check the "
                 "global batch; this is the per-shard constraint)"
             )
@@ -121,16 +159,20 @@ def make_pipeline_loss(
         s = jax.lax.axis_index("pp")
         rope = rope_table(model_cfg.head_dim, T)
 
-        # Embedding on every stage (replicated compute); only stage 0's
-        # result enters the pipeline, so only stage 0 contributes wte grad
-        # (shard_map's replicated-input transpose psums over 'pp').
-        h = jnp.take(params.wte, x, axis=0)  # (B, T, D)
+        # Embedding on every stage (replicated compute — a cheap gather);
+        # only stage 0's result enters the pipeline, so only stage 0
+        # contributes wte grad (shard_map's pp-replicated-input transpose
+        # psums over 'pp'; the fsdp gather transposes to reduce-scatter).
+        full_wte = _gather_leaf(params.wte, param_specs.wte)
+        full_head = _gather_leaf(params.lm_head, param_specs.lm_head)
+        h = jnp.take(full_wte, x, axis=0)  # (B, T, D)
         x_mb = h.reshape(M, Bm, T, model_cfg.n_embd)
 
         n_ticks = M + pp - 1
         perm = [(i, (i + 1) % pp) for i in range(pp)]
         stage_fn = functools.partial(
-            gpipe_stage_apply, model_cfg, params.blocks, rope=rope
+            gpipe_stage_apply, model_cfg, params.blocks, rope=rope,
+            layer_transform=gather_block,
         )
 
         def tick(carry, t):
@@ -155,16 +197,24 @@ def make_pipeline_loss(
         init = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
         (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
 
-        # Final norm + fused CE on the last stage's collected outputs; the
-        # masked psum broadcasts the scalar to all stages. Other stages'
-        # outs are zeros — their loss value is discarded by the mask, and
-        # its cotangent is zero, so no garbage gradients flow.
-        hidden = rms_norm(outs.reshape(B, T, model_cfg.n_embd), eps=1e-5)
+        # v2 loss: scatter the collected outputs over 'pp' so the final-norm
+        # + fused-CE matmul volume is 1× the batch, not pp×. Only the last
+        # stage's buffer is nonzero, so the scatter-SUM is a broadcast-slice:
+        # stage s receives rows [s·B/pp, (s+1)·B/pp). Each stage's CE is the
+        # mean over its equal-size token slice; pmean over 'pp' recombines
+        # the global mean. (Transpose: psum_scatter -> all_gather, so the
+        # backward hands the full outs-cotangent to the last stage's stash.)
+        shard = jax.lax.psum_scatter(
+            outs.reshape(B, T, model_cfg.n_embd), "pp",
+            scatter_dimension=0, tiled=True,
+        )  # (B/pp, T, D)
+        Bp = B // pp
+        y_s = jax.lax.dynamic_slice_in_dim(y, s * Bp, Bp, axis=0)
+        hidden = rms_norm(shard, eps=1e-5)
         loss = fused_linear_cross_entropy(
-            hidden, params.lm_head, y, loss_chunk_tokens, loss_remat_chunks
+            hidden, full_head, y_s, loss_chunk_tokens, loss_remat_chunks
         )
-        loss = jnp.where(s == pp - 1, loss, 0.0)
-        loss = jax.lax.psum(loss, "pp")
+        loss = jax.lax.pmean(loss, "pp")
         # global mean over the batch axes
         return jax.lax.pmean(loss, BATCH_AXES)
 

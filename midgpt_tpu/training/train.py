@@ -224,10 +224,9 @@ def init_state(config: ExperimentConfig, mesh) -> tp.Tuple[GPTParams, tp.Any, tp
     # — which with mesh tp=1 reduces to the plain FSDP rule exactly (pinned
     # by test_tp.py).
     if mesh.shape["pp"] > 1:
-        from midgpt_tpu.parallel.pipeline import pipeline_param_specs
-
-        def spec_rule(tree, *_args):
-            return pipeline_param_specs(tree)
+        # Same (tree, mesh, shard_model, min_size) signature as the tp rule:
+        # layer axis over 'pp', large leaves additionally over 'fsdp'.
+        from midgpt_tpu.parallel.pipeline import pipeline_param_specs as spec_rule
 
     else:
         from midgpt_tpu.parallel.tp import tp_param_specs

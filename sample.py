@@ -78,7 +78,21 @@ def main() -> None:
         if meta.get("kind") == "hf_bpe":
             from tokenizers import Tokenizer
 
-            tok = Tokenizer.from_file(os.path.join(config.data_dir, meta["tokenizer_file"]))
+            tok_path = os.path.join(config.data_dir, meta["tokenizer_file"])
+            want_sha = meta.get("tokenizer_sha256")
+            if want_sha is not None:
+                import hashlib
+
+                with open(tok_path, "rb") as tf:
+                    got_sha = hashlib.sha256(tf.read()).hexdigest()
+                if got_sha != want_sha:
+                    raise ValueError(
+                        f"{tok_path} does not match the tokenizer this "
+                        "dataset (and any checkpoint trained on it) was "
+                        "built with — decoding would be silently wrong. "
+                        "Re-run the dataset's prepare.py."
+                    )
+            tok = Tokenizer.from_file(tok_path)
             encode = lambda s: tok.encode(s).ids
             decode = lambda ids: tok.decode(ids, skip_special_tokens=False)
         else:
