@@ -89,6 +89,56 @@ def _divisor_block(Tl: int, block_size: int) -> int:
     return blk
 
 
+# (Tl, block_size) pairs already warned about — the fallback is a large,
+# silent-by-default perf cliff, so it gets exactly one loud line per shape.
+_WARNED: tp.Set[tp.Tuple[int, int]] = set()
+
+
+def _resolve_pair_plan(
+    Tl: int, block_size: int, use_kernel: tp.Optional[bool]
+) -> tp.Tuple[bool, int]:
+    """Decide (use_kernel, block_size) for this shard length, at trace time.
+
+    When the configured block does not tile Tl, prefer AUTO-ADJUSTING to the
+    largest divisor of Tl in [128, block_size] (8-aligned for the kernel's
+    sublane tiling) so the per-pair compute stays on the Pallas kernels —
+    e.g. Tl=1280 at block 1024 runs at block 640 instead of dropping to jnp.
+    Only when no such divisor exists fall back to the jnp pair path, and say
+    so ONCE per shape: the fallback preserves correctness but costs kernel
+    speed (the whole point of ring v2), which silently looks like 'ring
+    attention is slow'. Pure function of its arguments, so the forward and
+    backward rings always agree on the plan."""
+    if use_kernel is None:
+        use_kernel = _auto_use_kernel()
+    if not use_kernel:
+        return False, block_size
+    if _kernel_serves(Tl, block_size):
+        return True, block_size
+    for d in range(min(block_size, Tl), 127, -1):
+        if Tl % d == 0 and d % 8 == 0 and _kernel_serves(Tl, d):
+            return True, d
+    if (Tl, block_size) not in _WARNED:
+        _WARNED.add((Tl, block_size))
+        import warnings
+
+        divisors = [d for d in range(8, Tl + 1) if Tl % d == 0 and d % 8 == 0]
+        hint = (
+            f"e.g. attn_block_size={max(divisors)}"
+            if divisors
+            else "no 8-aligned divisor exists; change the sequence shard length"
+        )
+        warnings.warn(
+            f"ring attention: shard length {Tl} is not tileable by "
+            f"attn_block_size={block_size} and has no kernel-servable "
+            f"divisor >= 128 — per-pair compute falls back to the jnp path "
+            f"(correct but far slower than the Pallas kernels). Pick a "
+            f"block that divides the shard ({hint}).",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return False, block_size
+
+
 # ----------------------------------------------------------------------
 # per-pair attention: local q against one visiting K/V shard
 # ----------------------------------------------------------------------
@@ -223,8 +273,7 @@ def ring_attention(
 
 
 def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
-    if use_kernel is None:
-        use_kernel = _auto_use_kernel()
+    use_kernel, block_size = _resolve_pair_plan(q.shape[2], block_size, use_kernel)
     n = jax.lax.axis_size(axis_name)
     g = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -261,9 +310,8 @@ def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
 
 
 def _ring_bwd(axis_name, block_size, use_kernel, residuals, do):
-    if use_kernel is None:
-        use_kernel = _auto_use_kernel()
     q, k, v, out, lse = residuals
+    use_kernel, block_size = _resolve_pair_plan(q.shape[2], block_size, use_kernel)
     n = jax.lax.axis_size(axis_name)
     g = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -84,6 +84,17 @@ DECODE_CHUNK = 64
 assert DECODE_CHUNK & (DECODE_CHUNK - 1) == 0, "tail decomposition assumes a power of two"
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def _window_forward(config, params, window):
+    """Full forward on a static (B, S) window -> last-position logits.
+
+    Module-level jit (NOT a fresh jax.jit per generate call): the overflow
+    window is always exactly block_size wide — the fast path only exits the
+    cache once T_ctx + produced > S, so seq is at least S+1 long by the
+    first overflow token — giving ONE compile per (B, S) across all calls."""
+    return GPT.apply(config, params, window, inference=True)[:, -1]
+
+
 @functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 7), donate_argnums=(3,))
 def _decode_chunk(config, params, token, cache, temperature, top_k, top_p, n_steps, key):
     """n_steps sequential decode+sample steps as ONE device program.
@@ -101,6 +112,54 @@ def _decode_chunk(config, params, token, cache, temperature, top_k, top_p, n_ste
         body, (token, cache, key), None, length=n_steps
     )
     return token, cache, toks
+
+
+def restore_for_sampling(
+    ckpt_dir: str,
+    config,  # ExperimentConfig (duck-typed to avoid an import cycle)
+    mesh=None,
+) -> tp.Tuple[GPTParams, int]:
+    """Restore the 'params' item sharded over an inference mesh.
+
+    The naive restore targets ONE device — a 7B checkpoint can never load
+    that way. Here the abstract skeleton carries NamedShardings from the
+    same FSDP spec rule training uses, so Orbax reads each host's shards
+    straight into sharded device arrays (training/checkpoint.py restore
+    honors the target shardings), and the decode jits inherit the layout
+    via GSPMD. With one device (or mesh=None on a 1-chip host) this reduces
+    to the plain single-device restore. Returns (params, step)."""
+    from midgpt_tpu.parallel.fsdp import fsdp_param_specs, named_shardings
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    if mesh is None:
+        from midgpt_tpu.config import MeshConfig
+        from midgpt_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(MeshConfig(data=1, fsdp=jax.device_count(), sp=1))
+    model_cfg = config.model_config
+    abstract = jax.eval_shape(
+        lambda k: GPT.init(model_cfg, k), jax.random.PRNGKey(0)
+    )
+    specs = fsdp_param_specs(
+        abstract,
+        mesh,
+        shard_model=mesh.shape["fsdp"] > 1,
+        min_size=config.fsdp_min_size,
+    )
+    shardings = named_shardings(specs, mesh)
+    abstract = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(
+            s.shape, jnp.dtype(config.param_dtype), sharding=sh
+        ),
+        abstract,
+        shardings,
+    )
+    mngr = CheckpointManager(ckpt_dir)
+    step = mngr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
+    params = mngr.restore(step, {"params": abstract})["params"]
+    return params, step
 
 
 def generate(
@@ -156,16 +215,16 @@ def generate(
         out.append(toks.T)  # (B, n)
         produced += n
 
-    # Overflow: windowed full-forward per token (reference scheme).
+    # Overflow: windowed full-forward per token (reference scheme). The
+    # window is a static (B, S) slice — see _window_forward.
     if produced < max_new_tokens:
         seq = jnp.concatenate(out, axis=1)
-        forward = jax.jit(
-            lambda p, t: GPT.apply(config, p, t, inference=True)[:, -1]
-        )
         for _ in range(max_new_tokens - produced):
             key, k = jax.random.split(key)
             window = seq[:, -S:]
-            nxt = sample_logits(forward(params, window), k, temperature, top_k, top_p)
+            nxt = sample_logits(
+                _window_forward(config, params, window), k, temperature, top_k, top_p
+            )
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
         return seq
 

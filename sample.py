@@ -38,9 +38,7 @@ def main() -> None:
     import numpy as np
 
     from midgpt_tpu.config import from_json
-    from midgpt_tpu.models.gpt import GPT
-    from midgpt_tpu.sampling.engine import generate
-    from midgpt_tpu.training.checkpoint import CheckpointManager
+    from midgpt_tpu.sampling.engine import generate, restore_for_sampling
     from midgpt_tpu.utils.precision import cast_floating
 
     config_path = os.path.join(args.ckpt_dir, "config.json")
@@ -55,16 +53,13 @@ def main() -> None:
     model_cfg = config.model_config
     print(config)
 
-    # Abstract params skeleton -> restore just the "params" item.
-    abstract = jax.eval_shape(lambda k: GPT.init(model_cfg, k), jax.random.PRNGKey(0))
-    abstract = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.dtype(config.param_dtype)), abstract
-    )
-    mngr = CheckpointManager(args.ckpt_dir)
-    step = mngr.latest_step()
-    if step is None:
-        raise SystemExit(f"no checkpoint found under {args.ckpt_dir}")
-    params = mngr.restore(step, {"params": abstract})["params"]
+    # Restore just the "params" item, sharded over an inference mesh (all
+    # local devices on 'fsdp' — the 7B-class checkpoints cannot restore to
+    # one device; on a single chip this is the plain restore).
+    try:
+        params, step = restore_for_sampling(args.ckpt_dir, config)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
     print(f"restored checkpoint step {step}")
     params = cast_floating(params, jnp.dtype(config.compute_dtype))
 

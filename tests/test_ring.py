@@ -201,11 +201,42 @@ def test_ring_kernel_jnp_paths_agree():
 
 
 def test_ring_kernel_auto_falls_back_on_unservable_shard():
-    """Shard lengths the kernel blocks don't divide (Tl=160 at block 64)
-    must fall back to the jnp pair path rather than erroring — parity holds
-    either way."""
+    """Shard lengths with no kernel-servable block (Tl=160 at block 64: no
+    8-aligned divisor of 160 in [128, 64] exists) fall back to the jnp pair
+    path rather than erroring — parity holds, and the perf cliff announces
+    itself with a one-time RuntimeWarning naming the shapes."""
+    from midgpt_tpu.parallel import ring_attention as ring_mod
+
     q, k, v = _qkv(B=2, H=1, T=320, C=16)  # Tl=160 over sp=2; 160 % 64 != 0
     mesh = _mesh(2)
-    out = ring_attention_sharded(q, k, v, mesh, block_size=64, use_kernel=True)
+    ring_mod._WARNED.clear()
+    with pytest.warns(RuntimeWarning, match="shard length 160"):
+        out = ring_attention_sharded(q, k, v, mesh, block_size=64, use_kernel=True)
     ref = naive_causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_kernel_block_auto_adjusts_to_divisor():
+    """Tl=1280 at the default block 1024 does NOT fall back: the plan
+    auto-adjusts to the largest 8-aligned divisor in [128, 1024] (640) and
+    stays on the kernel path — no warning, kernel parity."""
+    import warnings
+
+    from midgpt_tpu.parallel import ring_attention as ring_mod
+
+    assert ring_mod._resolve_pair_plan(1280, 1024, True) == (True, 640)
+    # already servable (the dispatcher clamps the block to Tl): unchanged
+    assert ring_mod._resolve_pair_plan(160, 1024, True) == (True, 1024)
+    # fallback cases return use_kernel=False unchanged
+    ring_mod._WARNED.clear()
+    with pytest.warns(RuntimeWarning):
+        assert ring_mod._resolve_pair_plan(120, 64, True) == (False, 64)
+
+    q, k, v = _qkv(B=2, H=2, T=2560, C=32, dtype=jnp.float32)  # Tl=1280 over sp=2
+    mesh = _mesh(2)
+    ring_mod._WARNED.clear()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # no fallback warning
+        out = ring_attention_sharded(q, k, v, mesh, block_size=1024, use_kernel=True)
+    ref = naive_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)

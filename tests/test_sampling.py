@@ -72,6 +72,31 @@ def test_generate_past_block_size(params):
     assert bool((out[:, :30] == prompt).all())
 
 
+def test_generate_overflow_compiles_once(params, monkeypatch):
+    """Generation past the cache must not retrace per token OR per call:
+    the overflow window is a static (B, S) slice served by the module-level
+    `_window_forward` jit, so GPT.apply traces exactly ONCE across many
+    overflow tokens and repeated generate() calls (the fast path's
+    prefill/decode jits don't go through GPT.apply at all)."""
+    from midgpt_tpu.sampling import engine
+
+    jax.clear_caches()  # drop any _window_forward entry from earlier tests
+    calls = {"n": 0}
+    orig_apply = GPT.apply
+
+    def counting_apply(*a, **k):
+        calls["n"] += 1
+        return orig_apply(*a, **k)
+
+    monkeypatch.setattr(GPT, "apply", staticmethod(counting_apply))
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, CFG.vocab_size)
+    out = engine.generate(CFG, params, prompt, 40, temperature=0.0)
+    assert out.shape == (2, 48)  # 8 + 40 > S=32: 15+ overflow tokens
+    out2 = engine.generate(CFG, params, prompt, 44, temperature=0.0)
+    assert out2.shape == (2, 52)
+    assert calls["n"] == 1, f"overflow forward traced {calls['n']} times"
+
+
 def test_prefill_blockwise_arbitrary_length(params):
     """Prefill must handle prompt lengths that are not block multiples
     (regression: blockwise path used to require divisibility)."""
@@ -96,6 +121,43 @@ def test_generate_exact_fill_uses_cache(params):
         nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
         seq = jnp.concatenate([seq, nxt[:, None].astype(seq.dtype)], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_restore_for_sampling_sharded_over_virtual_mesh(params, tmp_path):
+    """Mesh-aware sampling restore: the checkpoint loads straight into
+    fsdp-sharded arrays on the 8-device virtual mesh (no single-device
+    staging — how the 7B-class checkpoints must load), values match the
+    saved params exactly, and greedy generation from the sharded restore
+    reproduces the unsharded model's output."""
+    import numpy as np
+
+    from midgpt_tpu.config import ExperimentConfig, MeshConfig
+    from midgpt_tpu.sampling.engine import generate, restore_for_sampling
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(str(tmp_path), max_to_keep=1, save_interval_steps=1)
+    mngr.save(0, {"params": params}, force=True)
+    mngr.wait()
+    mngr.close()
+
+    cfg = ExperimentConfig(
+        rundir="", data_dir="", learning_rate=1e-3, batch_size=8,
+        warmup_steps=1, min_lr=1e-4, lr_decay_steps=10, max_steps=10,
+        beta2=0.99, weight_decay=0.0, eval_interval=5, param_dtype="float32",
+        compute_dtype="float32", g_accum_iters=1, shard_model=True,
+        fsdp_min_size=0, model_config=CFG,
+    )
+    restored, step = restore_for_sampling(str(tmp_path), cfg)
+    assert step == 0
+    shard_specs = [str(l.sharding.spec) for l in jax.tree.leaves(restored)]
+    assert any("fsdp" in s for s in shard_specs), shard_specs
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, CFG.vocab_size)
+    out_sharded = generate(CFG, restored, prompt, 6, temperature=0.0)
+    out_ref = generate(CFG, params, prompt, 6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out_sharded), np.asarray(out_ref))
 
 
 def test_sample_logits_modes():
