@@ -79,6 +79,11 @@ class CheckpointManager:
     def latest_step(self) -> tp.Optional[int]:
         return self._mngr.latest_step()
 
+    def should_save(self, step: int) -> bool:
+        """Would a non-forced save at `step` actually persist? Lets the train
+        loop pay its pre-save health sync only on real save steps."""
+        return bool(self._mngr.should_save(step))
+
     def save(self, step: int, state: tp.Dict[str, tp.Any], *, force: bool = False) -> bool:
         """Queue an async save of named items (e.g. {"params": ..., "opt_state": ...});
         the manager filters by save_interval_steps unless `force` (used for the

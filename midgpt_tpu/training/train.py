@@ -171,6 +171,14 @@ def make_train_step(
         updates, opt_state = optimizer.update(grad, opt_state, params)
         params = optax.apply_updates(params, updates)
         params = constrain(params, param_specs, mesh)
+        # Post-UPDATE health, folded into the reported loss: the scalar loss
+        # is computed from the PRE-update params, so on its own it shows
+        # divergence one step after the poisoned state could already have
+        # been checkpointed. global_norm sweeps every update leaf (~1 ms at
+        # 124M); any NaN/Inf makes the returned loss NaN, which the host's
+        # divergence guard and pre-save gate both key on.
+        finite = jnp.isfinite(optax.global_norm(updates))
+        loss = jnp.where(finite, loss, jnp.nan)
         return params, opt_state, loss
 
     def _eval_loss_one(params_c: GPTParams, x: Array, y: Array) -> Array:
@@ -369,6 +377,23 @@ def train(config: ExperimentConfig) -> dict:
         tokens_since += config.batch_size * config.g_accum_iters * T
         if itr % config.log_interval == 0:
             loss_f = float(loss)
+            if not np.isfinite(loss_f):
+                # Divergence guard (no reference counterpart — its NaN runs
+                # burn wall-clock until someone looks at wandb): stop loudly
+                # at the already-paid log sync, WITHOUT saving the poisoned
+                # params over the rolling checkpoint, and say where the last
+                # good state is.
+                last_good = None
+                if mngr is not None:
+                    mngr.wait()
+                    last_good = mngr.latest_step()
+                raise FloatingPointError(
+                    f"non-finite loss ({loss_f}) at step {itr} — training "
+                    "has diverged. Last good checkpoint: "
+                    + (f"step {last_good} in {config.rundir}" if last_good is not None
+                       else "none was saved")
+                    + ". Lower learning_rate or raise warmup_steps and resume."
+                )
             dt = _time.time() - t_last
             tok_s = tokens_since / dt if dt > 0 else 0.0
             t_last, tokens_since = _time.time(), 0
@@ -394,7 +419,17 @@ def train(config: ExperimentConfig) -> dict:
                     f"tok/s {tok_s:,.0f}"
                 )
         progress.update(1)
-        if mngr is not None:
+        if mngr is not None and mngr.should_save(itr):
+            # One device sync per SAVE interval (not per step): never let a
+            # poisoned state overwrite the max_to_keep=1 rolling checkpoint.
+            if not np.isfinite(float(loss)):
+                mngr.wait()
+                raise FloatingPointError(
+                    f"non-finite training state at step {itr} — refusing to "
+                    "overwrite the rolling checkpoint. Last good checkpoint: "
+                    f"step {mngr.latest_step()} in {config.rundir}. Lower "
+                    "learning_rate or raise warmup_steps and resume."
+                )
             mngr.save(itr, {"params": params, "opt_state": opt_state})
 
     progress.close()
@@ -407,7 +442,9 @@ def train(config: ExperimentConfig) -> dict:
         # Force-persist the final state unless the in-loop save already did
         # (orbax raises StepAlreadyExists on a forced duplicate).
         mngr.wait()
-        if mngr.latest_step() != config.max_steps - 1:
+        if mngr.latest_step() != config.max_steps - 1 and np.isfinite(
+            metrics["loss/final"]
+        ):
             mngr.save(
                 config.max_steps - 1,
                 {"params": params, "opt_state": opt_state},

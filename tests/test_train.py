@@ -152,3 +152,36 @@ def test_evaluate_chunked_matches_monolithic(data_dir):
     xa, _ = ds.batch("val", 5, 16, 4, g_accum_iters=8)
     xs, _ = ds.batch("val", 5, 16, 4, g_accum_iters=8, accum_slice=(2, 3))
     np.testing.assert_array_equal(xa[2:5], xs)
+
+
+def test_divergence_guard_stops_loudly(data_dir, tmp_path):
+    """A diverging run (absurd lr) must raise FloatingPointError instead of
+    training on — or CHECKPOINTING — NaNs (auxiliary failure-detection the
+    reference lacks; SURVEY §5.3). The step folds a post-update finiteness
+    flag into the reported loss, so the pre-save gate sees poisoned params
+    the same iteration they appear: any checkpoint left behind must restore
+    to fully finite state."""
+    cfg = tiny_config(
+        data_dir,
+        rundir=str(tmp_path),
+        learning_rate=1e25,
+        min_lr=1e24,
+        warmup_steps=1,
+        log_interval=1,
+        max_steps=30,
+        eval_interval=2,  # saves would happen every 2 steps — none may be NaN
+    )
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        train(cfg)
+
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+    from midgpt_tpu.training.train import init_state
+
+    mngr = CheckpointManager(str(tmp_path))
+    step = mngr.latest_step()
+    if step is not None:  # whatever was saved must be clean
+        mesh = make_mesh(cfg.mesh)
+        params, opt_state, *_ = init_state(cfg, mesh)
+        state = mngr.restore(step, {"params": params, "opt_state": opt_state})
+        for leaf in jax.tree.leaves(state["params"]):
+            assert bool(jnp.isfinite(leaf).all()), "poisoned checkpoint saved"

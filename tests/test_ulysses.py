@@ -185,7 +185,8 @@ def test_ulysses_shard_map_fsdp_train_step_matches_gspmd():
     """Ulysses composes with the explicit shard_map ZeRO-3 schedule the same
     way the ring does (parallel/shard_map_fsdp.py): one body, weight gathers
     on 'fsdp', head<->sequence all_to_alls on 'sp'. Same loss as the GSPMD
-    Ulysses step AND the naive sp=1 oracle."""
+    Ulysses step, the naive sp=1 oracle, AND the tp x sp composition
+    (heads sharded over tp, then sp)."""
     from midgpt_tpu.config import ExperimentConfig, MeshConfig
     from midgpt_tpu.models.gpt import GPTConfig
     from midgpt_tpu.parallel.data import make_global_batch
@@ -223,13 +224,19 @@ def test_ulysses_shard_map_fsdp_train_step_matches_gspmd():
         mesh=MeshConfig(data=2, fsdp=2, sp=2), model_config=uly,
         fsdp_mode="shard_map", **base,
     )
+    # Megatron-TP composition (train.py passes head_axis='tp': heads shard
+    # over tp x sp, all-to-alls ride 'sp' within each head group)
+    tp_cfg = ExperimentConfig(
+        mesh=MeshConfig(data=1, fsdp=2, sp=2, tp=2), model_config=uly, **base
+    )
 
     rng = np.random.default_rng(0)
     x = rng.integers(0, mc.vocab_size, (1, 8, 64), dtype=np.int32)
     y = np.roll(x, -1, axis=-1)
     losses = {}
     for name, cfg in (
-        ("oracle", oracle_cfg), ("gspmd", gspmd_cfg), ("shard_map", sm_cfg)
+        ("oracle", oracle_cfg), ("gspmd", gspmd_cfg), ("shard_map", sm_cfg),
+        ("tp_sp", tp_cfg),
     ):
         mesh = make_mesh(cfg.mesh)
         params, opt_state, specs, optimizer = init_state(cfg, mesh)
@@ -241,6 +248,7 @@ def test_ulysses_shard_map_fsdp_train_step_matches_gspmd():
         losses[name] = float(loss)
     np.testing.assert_allclose(losses["gspmd"], losses["oracle"], rtol=1e-5)
     np.testing.assert_allclose(losses["shard_map"], losses["oracle"], rtol=1e-5)
+    np.testing.assert_allclose(losses["tp_sp"], losses["oracle"], rtol=1e-5)
 
 
 def test_ulysses_rejects_indivisible_heads_directly():
