@@ -93,7 +93,12 @@ class ExperimentConfig:
         # attention-probability dropout exists only on the naive path
         # (ops/attention.py dispatch).
         mc = self.model_config
-        if mc.qkv_proj not in ("fused", "split3"):
+        if not (0.0 < self.beta2 < 1.0):
+            # beta2 >= 1 makes adam's bias correction divide by zero on step
+            # 1 — a NaN source INSIDE the optimizer that the train step's
+            # grad-norm health check cannot see (its soundness induction
+            # assumes the chain maps finite state+grads to finite updates).
+            raise ValueError(f"beta2={self.beta2} must be in (0, 1)")
             # A typo here would silently fall back to the fused lowering AND
             # bypass the tp auto-switch (training/train.py) — fail loudly.
             raise ValueError(f"unknown qkv_proj {mc.qkv_proj!r} ('fused' or 'split3')")

@@ -185,3 +185,12 @@ def test_divergence_guard_stops_loudly(data_dir, tmp_path):
         state = mngr.restore(step, {"params": params, "opt_state": opt_state})
         for leaf in jax.tree.leaves(state["params"]):
             assert bool(jnp.isfinite(leaf).all()), "poisoned checkpoint saved"
+
+
+def test_beta2_validated_at_construction(data_dir):
+    """beta2 >= 1 would NaN adam's bias correction with finite grads —
+    invisible to the step's grad-norm health check — so it must be rejected
+    at config construction."""
+    for bad in (1.0, 1.5, 0.0):
+        with pytest.raises(ValueError, match="beta2"):
+            tiny_config(data_dir, beta2=bad)
