@@ -99,6 +99,7 @@ class ExperimentConfig:
             # grad-norm health check cannot see (its soundness induction
             # assumes the chain maps finite state+grads to finite updates).
             raise ValueError(f"beta2={self.beta2} must be in (0, 1)")
+        if mc.qkv_proj not in ("fused", "split3"):
             # A typo here would silently fall back to the fused lowering AND
             # bypass the tp auto-switch (training/train.py) — fail loudly.
             raise ValueError(f"unknown qkv_proj {mc.qkv_proj!r} ('fused' or 'split3')")

@@ -50,5 +50,9 @@ config = ExperimentConfig(
         # at batch 16 and loses ~10 points at 12 (RESULTS.md §1 wide table).
         remat=False,
         remat_policy="flash",
+        # Like the 124M recipe: remat-off only FITS with the layer scan
+        # fully unrolled (the bench's measured setting) — the rolled scan's
+        # per-iteration temps exceed HBM (OOMs at unroll=1).
+        scan_unroll=8,
     ),
 )

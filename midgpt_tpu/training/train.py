@@ -341,6 +341,27 @@ def train(config: ExperimentConfig) -> dict:
             )
             params, opt_state = state["params"], state["opt_state"]
             first_step = mngr.latest_step() + 1
+            # Base case of the per-step health induction (the in-step check
+            # watches grads, which cannot see a corrupted RESTORED state):
+            # one device-side finiteness sweep of params + opt_state at
+            # resume, one sync, never again.
+            restored_ok = jax.jit(
+                lambda t: jnp.all(
+                    jnp.array(
+                        [
+                            jnp.all(jnp.isfinite(l))
+                            for l in jax.tree.leaves(t)
+                            if jnp.issubdtype(l.dtype, jnp.floating)
+                        ]
+                    )
+                )
+            )((params, opt_state))
+            if not bool(restored_ok):
+                raise FloatingPointError(
+                    f"checkpoint step {mngr.latest_step()} in {config.rundir} "
+                    "restored non-finite values — it is corrupt; do not "
+                    "resume from it."
+                )
 
     logger = MetricLogger(config)
     profiler = Profiler(config.rundir, enabled=config.debug)

@@ -194,3 +194,46 @@ def test_beta2_validated_at_construction(data_dir):
     for bad in (1.0, 1.5, 0.0):
         with pytest.raises(ValueError, match="beta2"):
             tiny_config(data_dir, beta2=bad)
+
+
+def test_qkv_proj_validated_at_construction(data_dir):
+    """A qkv_proj typo must fail at construction — it would otherwise
+    silently select the fused lowering AND bypass the tp auto-switch."""
+    import dataclasses
+
+    with pytest.raises(ValueError, match="qkv_proj"):
+        tiny_config(
+            data_dir,
+            model_config=dataclasses.replace(
+                tiny_config(data_dir).model_config, qkv_proj="fuesd"
+            ),
+        )
+
+
+def test_resume_rejects_corrupt_checkpoint(data_dir, tmp_path):
+    """The health induction's base case: a restored checkpoint containing
+    NaN (corruption, bad migration) must abort the resume, not train on."""
+    cfg = tiny_config(
+        data_dir, rundir=str(tmp_path), max_steps=4, eval_interval=2,
+    )
+    train(cfg)  # writes a good checkpoint
+
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(str(tmp_path))
+    step = mngr.latest_step()
+    mesh = make_mesh(cfg.mesh)
+    params, opt_state, *_ = init_state(cfg, mesh)
+    state = mngr.restore(step, {"params": params, "opt_state": opt_state})
+    # poison one master-param leaf and save it back as a NEWER step
+    poisoned = state["params"]
+    poisoned = jax.tree.map(lambda x: x, poisoned)
+    leaves, treedef = jax.tree.flatten(poisoned)
+    leaves[0] = leaves[0].at[0].set(jnp.nan)
+    poisoned = jax.tree.unflatten(treedef, leaves)
+    mngr.save(step + 1, {"params": poisoned, "opt_state": state["opt_state"]}, force=True)
+    mngr.wait()
+    mngr.close()
+
+    with pytest.raises(FloatingPointError, match="corrupt"):
+        train(cfg.replace(max_steps=10))
