@@ -105,6 +105,12 @@ def blockwise_causal_attention(
         # enclosing shard_map the carry inherits q's varying-manual-axes
         # annotation — a constant init trips scan's carry-type check there
         # (the Ulysses-inside-ZeRO-3 composition hits exactly this).
+        # Known trade-off (ADVICE r4): non-finite q makes this init NaN
+        # (inf*0), so the max/denom guards no longer protect fully-masked
+        # rows in that case — harmless, since non-finite q already poisons
+        # the output, and the train step's health gate catches it. If a
+        # newer JAX drops the varying-axes restriction, revert to constant
+        # inits.
         zeros_c = (q_i * 0).astype(jnp.float32)  # (B, H, blk, C)
         zeros_r = jnp.sum(zeros_c, axis=-1)  # (B, H, blk)
         init = (zeros_c, zeros_r + NEG_INF, zeros_r)

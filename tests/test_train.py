@@ -187,6 +187,55 @@ def test_divergence_guard_stops_loudly(data_dir, tmp_path):
             assert bool(jnp.isfinite(leaf).all()), "poisoned checkpoint saved"
 
 
+def test_health_flag_semantics():
+    """The step's health check (train.health_flag) must (a) NOT flag
+    large-but-finite grads whose squared global norm overflows fp32 — clip(1.0)
+    recovers from those, a hard stop would be spurious (ADVICE r4); (b) flag
+    any NaN/Inf grad leaf or loss; (c) be sticky through the reported-loss
+    carrier: a finite step after a poisoned one must still report NaN."""
+    from midgpt_tpu.training.train import health_flag
+
+    ok = jnp.float32(2.5)
+    prev = jnp.float32(0.1)
+    huge = {"a": jnp.full((64,), 1e20, jnp.float32), "b": jnp.ones((3,))}
+    # (a) squared norm overflows to inf, but every leaf is finite -> healthy
+    import optax
+
+    assert not bool(jnp.isfinite(optax.global_norm(huge))), "premise: overflow"
+    assert float(health_flag(huge, ok, prev)) == 2.5
+    # (b) one NaN leaf / one inf leaf / NaN loss -> poisoned
+    bad_nan = {"a": jnp.ones((4,)).at[2].set(jnp.nan)}
+    bad_inf = {"a": jnp.ones((4,)).at[0].set(jnp.inf)}
+    assert not np.isfinite(float(health_flag(bad_nan, ok, prev)))
+    assert not np.isfinite(float(health_flag(bad_inf, ok, prev)))
+    assert not np.isfinite(float(health_flag(huge, jnp.float32(jnp.nan), prev)))
+    # (c) sticky: clean step, poisoned history -> still NaN
+    assert not np.isfinite(float(health_flag(huge, ok, jnp.float32(jnp.nan))))
+
+
+def test_step_sticky_health(data_dir):
+    """End-to-end stickiness through the compiled step: passing a NaN
+    prev_loss into an otherwise healthy step must return NaN loss, so a
+    poisoning at a never-inspected step reaches the next log/save gate."""
+    cfg = tiny_config(data_dir, max_steps=2, eval_interval=100)
+    mesh = make_mesh(cfg.mesh)
+    params, opt_state, specs, optimizer = init_state(cfg, mesh)
+    step, *_ = make_train_step(cfg, optimizer, mesh, specs)
+    ds = TokenDataset(str(data_dir), seed=3)
+    x, y = ds.batch("train", 0, cfg.model_config.block_size, cfg.batch_size, 1)
+    xg = make_global_batch(x, mesh, batch_spec())
+    yg = make_global_batch(y, mesh, batch_spec())
+    _, _, loss = step(
+        jax.tree.map(jnp.copy, params), jax.tree.map(jnp.copy, opt_state),
+        xg, yg, jax.random.PRNGKey(0), jnp.float32(jnp.nan),
+    )
+    assert not np.isfinite(float(loss)), "health flag not sticky"
+    # and a clean history reports the true (finite) loss
+    _, _, loss2 = step(params, opt_state, xg, yg, jax.random.PRNGKey(0),
+                       jnp.float32(0.0))
+    assert np.isfinite(float(loss2))
+
+
 def test_beta2_validated_at_construction(data_dir):
     """beta2 >= 1 would NaN adam's bias correction with finite grads —
     invisible to the step's grad-norm health check — so it must be rejected
