@@ -1,0 +1,46 @@
+"""Golden-loss regression: a committed 200-step fp32 trajectory must
+reproduce within tolerance (VERDICT r4 weak #6 — `loss/final < 1.0` alone
+would pass a wrong-eps / swapped-beta / init-drift regression).
+
+Calibration (measured, r5): re-running on the same stack reproduces the
+fixture to 0.0 abs diff; seeded regressions deflect it by 6e-4 (RMSNorm eps
+1e-6 -> 1e-4) to 1.9e-2 (init scale * 1.05). atol 1e-4 sits between.
+
+If this fails after a DELIBERATE numerics/spec change (or a JAX upgrade —
+the fixture records the version), verify the new trajectory is sane and
+regenerate with `python tools/make_golden_fixture.py`. Never regenerate to
+silence an unexplained shift.
+"""
+
+import json
+import os
+
+import numpy as np
+
+import golden_runner
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "golden", "tiny_fp32.json")
+
+
+def test_golden_loss_trajectory(tmp_path):
+    with open(FIXTURE) as f:
+        fixture = json.load(f)
+    assert fixture["spec"] == golden_runner.GOLDEN_SPEC, (
+        "GOLDEN_SPEC changed without regenerating the fixture — run "
+        "python tools/make_golden_fixture.py (only for deliberate changes)"
+    )
+    golden_runner.make_stream(str(tmp_path))
+    losses = golden_runner.run_trajectory(str(tmp_path))
+    np.testing.assert_allclose(
+        np.array(losses),
+        np.array(fixture["losses"]),
+        rtol=0,
+        atol=1e-4,
+        err_msg=(
+            "training trajectory drifted from the golden fixture "
+            f"(generated on {fixture['versions']}) — a numerics "
+            "regression in init/optimizer/loss, or a software-stack change "
+            "(jax math, numpy Generator streams, optax internals); "
+            "see tests/test_golden_loss.py docstring"
+        ),
+    )

@@ -1,0 +1,58 @@
+"""Regenerate tests/golden/tiny_fp32.json (the golden-loss fixture).
+
+Run this ONLY when GOLDEN_SPEC legitimately changes (never to paper over an
+unexplained trajectory shift — that is the regression the fixture exists to
+catch). Must run on the same 8-device virtual CPU mesh the tests use:
+
+    python tools/make_golden_fixture.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import conftest  # noqa: E402,F401  — THE jax config the tests run under
+import jax  # noqa: E402
+
+import golden_runner  # noqa: E402
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as d:
+        golden_runner.make_stream(d)
+        losses = golden_runner.run_trajectory(d)
+    out = os.path.join(
+        os.path.dirname(__file__), "..", "tests", "golden", "tiny_fp32.json"
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        import numpy
+        import optax
+
+        json.dump(
+            {
+                "spec": golden_runner.GOLDEN_SPEC,
+                # The trajectory depends on all three stacks: jax (compiled
+                # math + threefry), numpy (Generator method streams are NOT
+                # guaranteed stable across feature releases, NEP 19), optax
+                # (chain internals).
+                "versions": {
+                    "jax": jax.__version__,
+                    "numpy": numpy.__version__,
+                    "optax": optax.__version__,
+                },
+                "losses": losses,
+            },
+            f,
+            indent=1,
+        )
+    print(f"wrote {out}: {losses[:3]} ... {losses[-3:]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
