@@ -20,9 +20,20 @@ train.py:87). This module is the TPU-first redesign: the FSDP schedule is
   * The loss is a `pmean` over ('data', 'fsdp') — the only explicit
     collective in the module besides the gathers.
 
-XLA's latency-hiding scheduler overlaps the (async) gather of layer l+1 with
-the compute of layer l when `scan_unroll > 1` exposes both in one iteration
-body.
+Gather/compute overlap is pinned, not assumed (r5):
+  * tests/test_shard_map_fsdp.py::test_zero3_gathers_schedulable_ahead_of_compute
+    asserts the dataflow precondition on the compiled step — at
+    scan_unroll=2 no weight gather in the scan body depends on the body's
+    compute, so the scheduler is free to issue layer l+1's gathers during
+    layer l.
+  * tools/check_overlap_tpu.py AOT-compiles this step for a v5e:2x4
+    topology and asserts the TPU compiler actually exploits that freedom:
+    the body's weight gathers become async (annotated
+    async_collective_name="all-gather-start") or are continuation-FUSED
+    into the block matmul kernels (gather windows streamed inside the dots,
+    forward and backward). Measured result in RESULTS.md §3a. NOTE: that
+    requires xla_tpu_enable_latency_hiding_scheduler=true — NOT default-on
+    in this toolchain; real-pod launches should set it (docs/PARALLELISM.md).
 
 Numerical parity with the GSPMD path is asserted in
 tests/test_shard_map_fsdp.py (same loss and same grads to fp32 tolerance on

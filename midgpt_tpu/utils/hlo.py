@@ -1,0 +1,110 @@
+"""Compiled-HLO introspection helpers shared by tests and tools.
+
+Used by the structural pins that keep scheduling claims honest:
+tests/test_shard_map_fsdp.py (gather/compute dataflow independence),
+tests/test_configs_compile.py (at-scale configs lower), and
+tools/check_overlap_tpu.py (TPU async-collective behavior). One parser and
+one abstract-lowering scaffold so the pins can't drift apart.
+"""
+
+from __future__ import annotations
+
+import re
+import typing as tp
+
+
+def hlo_computations(txt: str) -> tp.Dict[str, tp.List[str]]:
+    """Parse post-optimization HLO text into {computation: instruction lines}.
+
+    Computation headers look like `%name (args) -> type {` (ENTRY-prefixed
+    for main); instructions are the indented lines until the closing `}`.
+    """
+    comps: tp.Dict[str, tp.List[str]] = {}
+    name = None
+    for raw in txt.splitlines():
+        line = raw.rstrip()
+        if name is None:
+            m = re.match(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(", line)
+            if m and line.endswith("{"):
+                name = m.group(1)
+                comps[name] = []
+        elif line == "}":
+            name = None
+        else:
+            comps[name].append(line.strip())
+    return comps
+
+
+def while_body_names(txt: str) -> tp.Set[str]:
+    """Names of computations used as a while-loop body (``body=%name``)."""
+    return set(re.findall(r"body=%([\w.\-]+)", txt))
+
+
+def is_forward_body(lines: tp.Sequence[str]) -> bool:
+    """Forward (jvp) vs backward (transpose(jvp)) scan-body classification,
+    shared by tests/test_shard_map_fsdp.py and tools/check_overlap_tpu.py so
+    the two overlap pins can't drift on what they call 'forward'."""
+    return any(
+        "jvp()/shard_map/while" in l and "transpose(" not in l for l in lines
+    )
+
+
+def lower_abstract_train_step(config, mesh=None):
+    """Lower the full training step against ABSTRACT sharded inputs.
+
+    No buffers are materialized, so this works for 7B-class configs on a
+    CPU test host and for AOT device topologies (tools/check_overlap_tpu.py
+    passes a mesh built from jax.experimental.topologies devices).
+    Param/optimizer sharding specs follow the same rule selection as
+    training/train.py init_state (pipeline rule under pp>1, else the
+    Megatron-tp rule, which reduces to plain FSDP at tp=1).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.parallel.fsdp import named_shardings
+    from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
+    from midgpt_tpu.training.optim import make_optimizer
+    from midgpt_tpu.training.train import make_train_step
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh)
+    mc = config.model_config
+    optimizer, _ = make_optimizer(config)
+
+    if mesh.shape["pp"] > 1:
+        from midgpt_tpu.parallel.pipeline import pipeline_param_specs as spec_rule
+    else:
+        from midgpt_tpu.parallel.tp import tp_param_specs
+
+        spec_rule = functools.partial(tp_param_specs, vocab_parallel=config.tp_vocab)
+
+    abstract_params = jax.eval_shape(
+        lambda k: GPT.init(mc, k), jax.random.PRNGKey(0)
+    )
+    param_specs = spec_rule(
+        abstract_params, mesh, config.shard_model, config.fsdp_min_size
+    )
+    params_abs = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, jnp.float32, sharding=s),
+        abstract_params,
+        named_shardings(param_specs, mesh),
+    )
+    opt_abs = jax.eval_shape(optimizer.init, params_abs)
+    opt_specs = spec_rule(opt_abs, mesh, config.shard_model, config.fsdp_min_size)
+    opt_abs = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        opt_abs,
+        named_shardings(opt_specs, mesh),
+    )
+
+    step, _, _ = make_train_step(config, optimizer, mesh, param_specs)
+    G, B, T = config.g_accum_iters, config.batch_size, mc.block_size
+    data_sh = NamedSharding(mesh, batch_spec(shard_seq=mesh.shape["sp"] > 1))
+    x_abs = jax.ShapeDtypeStruct((G, B, T), jnp.int32, sharding=data_sh)
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    return step.lower(params_abs, opt_abs, x_abs, x_abs, key_abs)

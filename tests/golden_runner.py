@@ -46,8 +46,12 @@ GOLDEN_SPEC = {
 
 
 def make_stream(tmpdir: str) -> str:
-    """Deterministic learnable token stream (PCG64 is stable across numpy
-    versions/platforms): token[i] = i % period, 10% replaced with noise."""
+    """Deterministic learnable token stream: token[i] = i % period, 10%
+    replaced with noise. Deterministic for a FIXED numpy version: the PCG64
+    bit stream is guaranteed, but Generator method streams (random/integers)
+    may change across numpy feature releases (NEP 19) — which is why the
+    fixture records numpy's version and the test's failure message names it
+    as a suspect."""
     spec = GOLDEN_SPEC
     rng = np.random.default_rng(0)
     n = spec["stream_tokens"]

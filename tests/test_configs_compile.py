@@ -10,48 +10,9 @@ stops short of backend codegen).
 
 import dataclasses
 
-import jax
-import jax.numpy as jnp
 import pytest
-from jax.sharding import NamedSharding
 
-from midgpt_tpu.models.gpt import GPT
-from midgpt_tpu.parallel.fsdp import fsdp_param_specs, named_shardings
-from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
-from midgpt_tpu.training.optim import make_optimizer
-from midgpt_tpu.training.train import make_train_step
-
-
-def _lower_train_step(config):
-    mesh = make_mesh(config.mesh)
-    mc = config.model_config
-    optimizer, _ = make_optimizer(config)
-
-    abstract_params = jax.eval_shape(
-        lambda k: GPT.init(mc, k), jax.random.PRNGKey(0)
-    )
-    param_specs = fsdp_param_specs(
-        abstract_params, mesh, config.shard_model, config.fsdp_min_size
-    )
-    p_sh = named_shardings(param_specs, mesh)
-    params_abs = jax.tree.map(
-        lambda a, s: jax.ShapeDtypeStruct(a.shape, jnp.float32, sharding=s),
-        abstract_params,
-        p_sh,
-    )
-    opt_abs = jax.eval_shape(optimizer.init, params_abs)
-    opt_specs = fsdp_param_specs(opt_abs, mesh, config.shard_model, config.fsdp_min_size)
-    o_sh = named_shardings(opt_specs, mesh)
-    opt_abs = jax.tree.map(
-        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s), opt_abs, o_sh
-    )
-
-    step, _, _ = make_train_step(config, optimizer, mesh, param_specs)
-    G, B, T = config.g_accum_iters, config.batch_size, mc.block_size
-    data_sh = NamedSharding(mesh, batch_spec(shard_seq=mesh.shape["sp"] > 1))
-    x_abs = jax.ShapeDtypeStruct((G, B, T), jnp.int32, sharding=data_sh)
-    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
-    return step.lower(params_abs, opt_abs, x_abs, x_abs, key_abs)
+from midgpt_tpu.utils.hlo import lower_abstract_train_step as _lower_train_step
 
 
 @pytest.mark.parametrize(

@@ -119,6 +119,114 @@ def test_train_step_e2e_shard_map():
     assert np.isfinite(float(loss))
 
 
+from midgpt_tpu.utils.hlo import (  # noqa: E402
+    hlo_computations as _hlo_computations,
+    is_forward_body,
+)
+
+
+def _fusion_calls_dot(line, comps):
+    """Does this fusion instruction's called computation contain a dot?"""
+    import re
+
+    m = re.search(r"calls=%([\w.\-]+)", line)
+    if not m or m.group(1) not in comps:
+        return False
+    return any(" dot(" in l for l in comps[m.group(1)])
+
+
+def test_zero3_gathers_schedulable_ahead_of_compute():
+    """Structural pin of the ZeRO-3 overlap claim (shard_map_fsdp.py header;
+    VERDICT r4 weak #2): in the compiled layer-scan body at scan_unroll=2,
+    EVERY weight all-gather's transitive operand chain is free of compute
+    (dot, or fusion-calling-dot) from the same body. That is the dataflow
+    property that lets XLA's latency-hiding scheduler issue the gather of
+    layer l+1 during layer l's compute; if a refactor ever made the gathers
+    depend on activations (serializing the stream), this fails. The actual
+    async overlap (all-gather-start/-done split around compute) is a TPU
+    scheduler behavior — asserted against the real backend by
+    tools/check_overlap_tpu.py, whose measured result is recorded in
+    RESULTS.md; the CPU backend emits synchronous all-gathers.
+
+    Also pins that unroll=2 exposes BOTH layers' gathers in one body (the
+    precondition for cross-layer overlap): 2 layers x 6 block leaves = 12."""
+    import re
+
+    from midgpt_tpu.utils.hlo import lower_abstract_train_step
+
+    config = ExperimentConfig(
+        rundir="",
+        data_dir="",
+        learning_rate=1e-3,
+        batch_size=8,
+        warmup_steps=2,
+        min_lr=1e-4,
+        lr_decay_steps=10,
+        max_steps=10,
+        eval_interval=5,
+        beta2=0.95,
+        weight_decay=1e-4,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=1,
+        shard_model=True,
+        fsdp_min_size=0,
+        fsdp_mode="shard_map",
+        mesh=MeshConfig(data=1, fsdp=8, sp=1),
+        model_config=GPTConfig(
+            block_size=64, vocab_size=64, n_layer=4, n_head=2, n_embd=64,
+            scan_unroll=2,
+        ),
+    )
+    txt = lower_abstract_train_step(config).compile().as_text()
+
+    comps = _hlo_computations(txt)
+    # Scan bodies containing weight gathers: the forward body (jvp) and the
+    # backward body (transpose(jvp), ZeRO-3 re-gather under remat).
+    bodies = {
+        name: lines
+        for name, lines in comps.items()
+        if any(" all-gather(" in l and "shard_map/while" in l for l in lines)
+        and any(" dot(" in l for l in lines)
+    }
+    assert bodies, "no scan body with all-gathers found — did lowering change?"
+
+    fwd_counts = []
+    for name, lines in bodies.items():
+        defs = {}
+        for line in lines:
+            m = re.match(r"^(?:ROOT\s+)?%([\w.\-]+)\s*=", line)
+            if not m:
+                continue
+            iname = m.group(1)
+            deps = [r for r in re.findall(r"%([\w.\-]+)", line) if r != iname]
+            defs[iname] = (line, deps)
+        gathers = [n for n, (l, _) in defs.items() if " all-gather(" in l]
+        if is_forward_body([l for l, _ in defs.values()]):
+            fwd_counts.append(len(gathers))
+        for g in gathers:
+            seen, stack = set(), list(defs[g][1])
+            while stack:
+                d = stack.pop()
+                if d in seen or d not in defs:
+                    continue
+                seen.add(d)
+                line, deps = defs[d]
+                assert " dot(" not in line, (
+                    f"{name}: gather %{g} depends on compute %{d} — the "
+                    "ZeRO-3 weight stream is serialized behind layer compute"
+                )
+                assert not (" fusion(" in line and _fusion_calls_dot(line, comps)), (
+                    f"{name}: gather %{g} depends on dot-fusion %{d}"
+                )
+                stack.extend(deps)
+    # Both unrolled layers' gathers live in one forward body: 2 x 6 leaves.
+    assert any(c >= 12 for c in fwd_counts), (
+        f"forward body gather counts {fwd_counts} — expected >= 12 "
+        "(scan_unroll=2 no longer exposes both layers' gathers in one body)"
+    )
+
+
 def test_loss_and_grads_match_gspmd_with_ring():
     """The composition: explicit shard_map FSDP x ring sequence parallelism
     in ONE shard_map body (per-layer weight gathers on 'fsdp', K/V rotation
