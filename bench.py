@@ -34,7 +34,7 @@ def main() -> int:
                         help="g_accum_iters: microbatches per step (the "
                         "production 124M recipe uses 16 — reference "
                         "configs/openwebtext.py:18)")
-    parser.add_argument("--attn", type=str, default=None, choices=[None, "naive", "flash", "blockwise"])
+    parser.add_argument("--attn", type=str, default=None, choices=["naive", "flash", "blockwise"])
     parser.add_argument("--remat", type=str, default="off",
                         choices=["off", "none", "dots", "dots_attn", "flash"],
                         help="off = no per-block checkpoint; else checkpoint policy")
@@ -50,6 +50,13 @@ def main() -> int:
         "attention MXU utilization to probe the >=55%% MFU target",
     )
     parser.add_argument("--layers", type=int, default=None, help="override n_layer")
+    parser.add_argument("--rope", type=str, default=None,
+                        choices=["interleaved", "split"],
+                        help="RoPE lowering override (default: the shape "
+                        "config's setting)")
+    parser.add_argument("--attn-layout", type=str, default=None,
+                        choices=["seq", "head"],
+                        help="attention activation layout override")
     args = parser.parse_args()
 
     from midgpt_tpu.config import MeshConfig
@@ -91,6 +98,8 @@ def main() -> int:
         remat_policy=args.remat if args.remat != "off" else "none",
         scan_unroll=args.unroll,
         **({"attn_block_size": args.attn_block} if args.attn_block else {}),
+        **({"rope_style": args.rope} if args.rope else {}),
+        **({"attn_layout": args.attn_layout} if args.attn_layout else {}),
     )
     config = base_config.replace(
         **({"loss_chunk_tokens": args.loss_chunk} if args.loss_chunk else {}),

@@ -103,6 +103,19 @@ class ExperimentConfig:
             # A typo here would silently fall back to the fused lowering AND
             # bypass the tp auto-switch (training/train.py) — fail loudly.
             raise ValueError(f"unknown qkv_proj {mc.qkv_proj!r} ('fused' or 'split3')")
+        if mc.rope_style not in ("interleaved", "split"):
+            # A typo would silently run the interleaved rotation on weights
+            # the caller expected permuted (or vice versa) — wrong math that
+            # trains; fail at construction like qkv_proj.
+            raise ValueError(
+                f"unknown rope_style {mc.rope_style!r} ('interleaved' or 'split')"
+            )
+        if mc.rope_style == "split" and mc.head_dim % 2 != 0:
+            raise ValueError("rope_style='split' needs an even head_dim")
+        if mc.attn_layout not in ("seq", "head"):
+            raise ValueError(
+                f"unknown attn_layout {mc.attn_layout!r} ('seq' or 'head')"
+            )
         if mc.dropout > 0.0 and mc.attn_impl != "naive":
             raise ValueError(
                 f"attn_impl={mc.attn_impl!r} does not support attention "

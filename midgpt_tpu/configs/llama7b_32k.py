@@ -34,5 +34,6 @@ config = ExperimentConfig(
         n_embd=4096,
         dropout=0.0,
         attn_impl="ring",
+        rope_style="split",  # same-function fast RoPE (see openwebtext.py)
     ),
 )

@@ -31,5 +31,6 @@ config = ExperimentConfig(
         n_embd=4096,
         dropout=0.0,
         attn_impl="flash",
+        rope_style="split",  # same-function fast RoPE (see openwebtext.py)
     ),
 )

@@ -51,5 +51,6 @@ config = ExperimentConfig(
         # measured setting): the rolled scan's per-iteration temps push the
         # no-remat activation set past 15.75 GB (OOMs at unroll=1).
         scan_unroll=12,
+        rope_style="split",  # same-function fast RoPE (see openwebtext.py)
     ),
 )

@@ -21,6 +21,8 @@ config = ExperimentConfig(
     shard_model=False,
     mesh=MeshConfig(data=-1, fsdp=8, sp=1),
     model_config=GPTConfig(
-        block_size=1024, vocab_size=50304, n_layer=12, n_head=12, n_embd=768, dropout=0.0
+        block_size=1024, vocab_size=50304, n_layer=12, n_head=12, n_embd=768,
+        dropout=0.0,
+        rope_style="split",  # same-function fast RoPE (see openwebtext.py)
     ),
 )

@@ -54,5 +54,9 @@ config = ExperimentConfig(
         # fully unrolled (the bench's measured setting) — the rolled scan's
         # per-iteration temps exceed HBM (OOMs at unroll=1).
         scan_unroll=8,
+        rope_style="split",
+        # At C=128 the head-major end-to-end layout wins (+1.2 MFU, 63.9%
+        # measured r5); at C=64 it loses — keep 'seq' there (RESULTS §4a).
+        attn_layout="head",
     ),
 )

@@ -86,6 +86,30 @@ class GPTConfig:
     # 'split3' = batched per-third einsum (required under tensor parallelism
     # — auto-selected by the training runtime when mesh tp > 1).
     qkv_proj: str = "fused"
+    # RoPE lowering. 'interleaved' computes the reference rotation directly
+    # (reference layers.py:79-99). 'split' computes the SAME function via a
+    # per-head permutation of the q/k projection rows applied in-graph
+    # (checkpoints stay in reference convention) + the contiguous
+    # rotate-half form — mathematically identical (QK^T is invariant under
+    # a shared permutation of the C axis; pinned by test_rope/test_model),
+    # and measured 12.3 ms/step faster on the 124M v5e bench (RESULTS §4a
+    # r5: the interleaved form's stride-2 gathers cost copy passes in fwd
+    # AND bwd). Per-run choice recorded in config.json, so restores and
+    # sampling stay consistent.
+    rope_style: str = "interleaved"
+    # Internal activation layout of the attention fast paths (flash kernel /
+    # injected ring/ulysses — both consume head-major):
+    #   'seq'  — project to (B,T,H,C), transpose to the kernel and back
+    #            (the r1-r4 structure).
+    #   'head' — project DIRECTLY to (B,H,T,C) (einsum btd,xhcd->xbhtc),
+    #            QK-norm + RoPE in head-major, kernel without transposes,
+    #            and merge+output-projection as ONE contraction
+    #            (bhtc,dhc->btd). Same math, same params, same checkpoints —
+    #            only the einsum axis order changes; kills the per-layer
+    #            head-transpose copies the profiler showed (~12% of the r5
+    #            124M step was relayout copies, RESULTS §4a).
+    # The naive/blockwise reference paths always use 'seq'.
+    attn_layout: str = "seq"
 
     @property
     def head_dim(self) -> int:
@@ -233,6 +257,47 @@ class GPT:
         return GPTParams(wte=embed, blocks=blocks, lm_head=embed)
 
     @staticmethod
+    def _qkv_weights(
+        config: GPTConfig, block: BlockParams
+    ) -> tp.Tuple[Array, Array, Array]:
+        """(wqkv (3,D,D), q_scale, k_scale), rope_style-adjusted.
+
+        For rope_style='split', conjugate by the per-head C permutation on
+        the WEIGHT side (one (2,D,D)-sized gather per layer, ~µs) instead of
+        on the (B,T,H,C) activations (the expensive side): q/k emerge with
+        interleaved pair (2i, 2i+1) at (i, i+C/2), so RoPE can use
+        contiguous rotate-half. QK-norm and QK^T are permutation-invariant;
+        v/att/wo untouched. Stored weights stay in the reference convention
+        — checkpoints need no migration."""
+        wqkv = block.attn.wqkv
+        q_scale, k_scale = block.attn.q_scale, block.attn.k_scale
+        if config.rope_style == "split":
+            from midgpt_tpu.ops.rope import split_permutation
+
+            D, H, C = config.n_embd, config.n_head, config.head_dim
+            perm = split_permutation(C)
+            wqk = wqkv[:2].reshape(2, H, C, D)[:, :, perm, :].reshape(2, D, D)
+            wqkv = jnp.concatenate((wqk, wqkv[2:]), axis=0)
+            q_scale, k_scale = q_scale[perm], k_scale[perm]
+        return wqkv, q_scale, k_scale
+
+    @staticmethod
+    def _project_qkv_bhtc(
+        config: GPTConfig, block: BlockParams, h: Array
+    ) -> tp.Tuple[Array, Array, Array]:
+        """h (B, T, D) -> q, k, v directly HEAD-major (B, H, T, C), after
+        QK-LayerNorm (no RoPE) — the attn_layout='head' projection: the
+        head split rides the projection einsum's output axes instead of a
+        separate transpose copy. Same contraction, same params."""
+        H, C = config.n_head, config.head_dim
+        wqkv, q_scale, k_scale = GPT._qkv_weights(config, block)
+        w = wqkv.reshape(3, H, C, config.n_embd)
+        qkv = jnp.einsum("btd,xhcd->xbhtc", h, w)
+        q = head_layer_norm(qkv[0], q_scale)
+        k = head_layer_norm(qkv[1], k_scale)
+        return q, k, qkv[2]
+
+    @staticmethod
     def _project_qkv(
         config: GPTConfig, block: BlockParams, h: Array
     ) -> tp.Tuple[Array, Array, Array]:
@@ -254,14 +319,15 @@ class GPT:
                      (training/train.py)."""
         B, T, D = h.shape
         H, C = config.n_head, config.head_dim
+        wqkv, q_scale, k_scale = GPT._qkv_weights(config, block)
         if config.qkv_proj == "split3":
-            qkv = jnp.einsum("btd,xed->btxe", h, block.attn.wqkv)  # (B, T, 3, D)
+            qkv = jnp.einsum("btd,xed->btxe", h, wqkv)  # (B, T, 3, D)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
-            qkv = jnp.einsum("btd,ed->bte", h, block.attn.wqkv.reshape(3 * D, D))
+            qkv = jnp.einsum("btd,ed->bte", h, wqkv.reshape(3 * D, D))
             q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = head_layer_norm(q.reshape(B, T, H, C), block.attn.q_scale)
-        k = head_layer_norm(k.reshape(B, T, H, C), block.attn.k_scale)
+        q = head_layer_norm(q.reshape(B, T, H, C), q_scale)
+        k = head_layer_norm(k.reshape(B, T, H, C), k_scale)
         v = v.reshape(B, T, H, C)
         return q, k, v
 
@@ -270,16 +336,26 @@ class GPT:
         config: GPTConfig,
         block: BlockParams,
         x: Array,  # (B, T, D) residual stream
-        att: Array,  # (B, T, H, C) attention output (sequence-major)
+        att: Array,  # (B, T, H, C), or (B, H, T, C) when head_major
         *,
         k_resid: tp.Optional[KeyArray] = None,
         k_mlp: tp.Optional[KeyArray] = None,
         inference: bool = True,
+        head_major: bool = False,
     ) -> Array:
         """Shared tail of a block: merge heads, output proj, MLP, residuals."""
-        B, T, H, C = att.shape
-        att = att.reshape(B, T, config.n_embd)
-        att = jnp.einsum("btd,ed->bte", att, block.attn.wo)
+        if head_major:
+            # Merge + output projection as ONE contraction: wo's input axis
+            # decomposes as (H, C) in the merged order, so this equals
+            # reshape-merge + btd,ed->bte without the transpose copy.
+            H, C = config.n_head, config.head_dim
+            att = jnp.einsum(
+                "bhtc,ehc->bte", att, block.attn.wo.reshape(config.n_embd, H, C)
+            )
+        else:
+            B, T, H, C = att.shape
+            att = att.reshape(B, T, config.n_embd)
+            att = jnp.einsum("btd,ed->bte", att, block.attn.wo)
         att = dropout(att, config.dropout, k_resid, inference)
         x = x + att
         h = rms_norm(x)
@@ -310,26 +386,75 @@ class GPT:
             k_attn_drop = k_resid = k_mlp = None
 
         with jax.named_scope("attn"):
-            att = GPT._attention(
+            att, head_major = GPT._attention(
                 config, params, x, sin, cos, positions, attn_fn,
                 k_attn_drop, inference,
             )
         with jax.named_scope("mlp"):
             return GPT._attn_out_and_mlp(
                 config, params, x, att, k_resid=k_resid, k_mlp=k_mlp,
-                inference=inference,
+                inference=inference, head_major=head_major,
             )
+
+    @staticmethod
+    def _call_flash(config, T: int, q: Array, k: Array, v: Array) -> Array:
+        """Invoke the Pallas kernel on head-major (B,H,T,C) q/k/v, naming
+        the post-rope tensors for the 'flash' remat policy: with q/k/v
+        saved here and out/lse saved in the kernel's fwd rule, backward
+        resumes attention AD from residuals instead of replaying
+        transpose+RoPE+QK-norm+kernel. ONE definition for both attn_layout
+        modes so their remat/block-size behavior cannot drift."""
+        import importlib
+
+        from midgpt_tpu.ops.attention import flash_block_sizes
+
+        fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
+        bq, bk = flash_block_sizes(T, config.attn_block_size)
+        q = checkpoint_name(q, "q_rot")
+        k = checkpoint_name(k, "k_rot")
+        v = checkpoint_name(v, "v_proj")
+        return fa.flash_attention(q, k, v, bq, bk)
 
     @staticmethod
     def _attention(
         config, params, x, sin, cos, positions, attn_fn, k_attn_drop, inference
-    ) -> Array:
-        """QKV + RoPE + dispatched attention -> (B, T, H, C)."""
+    ) -> tp.Tuple[Array, bool]:
+        """QKV + RoPE + dispatched attention.
+
+        Returns (att, head_major): (B, H, T, C) with head_major=True when
+        the attn_layout='head' fast path ran, else (B, T, H, C) with False.
+        The flag is static (a function of config + dispatch), so the caller
+        branches at trace time."""
+        from midgpt_tpu.ops.attention import flash_kernel_usable
+
         h = rms_norm(x)  # weightless, eps 1e-6
+        flash_ok = (
+            config.attn_impl == "flash"
+            and (config.dropout == 0.0 or inference)  # kernel has no dropout;
+            # the dispatcher below raises for flash+dropout (training)
+            and flash_kernel_usable(x.shape[1], config.attn_block_size)
+        )
+        if config.attn_layout == "head" and (attn_fn is not None or flash_ok):
+            # Head-major end to end: no transposes between projection,
+            # kernel and merge (attn_layout docstring above).
+            q, k, v = GPT._project_qkv_bhtc(config, params, h)  # (B,H,T,C)
+            q = apply_rope(q, sin, cos, positions, style=config.rope_style)
+            k = apply_rope(k, sin, cos, positions, style=config.rope_style)
+            if attn_fn is not None:
+                if config.dropout != 0.0 and not inference:
+                    raise NotImplementedError(
+                        f"injected attention (attn_impl={config.attn_impl!r}) "
+                        "does not support attention-probability dropout; use "
+                        "attn_impl='naive' or set dropout=0.0"
+                    )
+                att = checkpoint_name(attn_fn(q, k, v), "attn_out")
+            else:
+                att = GPT._call_flash(config, x.shape[1], q, k, v)
+            return att, True
+
         q, k, v = GPT._project_qkv(config, params, h)  # (B, T, H, C)
-        q = apply_rope_bthc(q, sin, cos, positions)
-        k = apply_rope_bthc(k, sin, cos, positions)
-        from midgpt_tpu.ops.attention import flash_block_sizes, flash_kernel_usable
+        q = apply_rope_bthc(q, sin, cos, positions, style=config.rope_style)
+        k = apply_rope_bthc(k, sin, cos, positions, style=config.rope_style)
 
         if attn_fn is not None:
             # Runtime-injected attention (e.g. mesh-bound ring attention for
@@ -346,25 +471,14 @@ class GPT:
                 v.transpose(0, 2, 1, 3),
             )
             att = checkpoint_name(att, "attn_out").transpose(0, 2, 1, 3)
-        elif (
-            config.attn_impl == "flash"
-            and (config.dropout == 0.0 or inference)  # kernel has no dropout;
-            # the dispatcher below raises for flash+dropout (training)
-            and flash_kernel_usable(x.shape[1], config.attn_block_size)
-        ):
-            # Call the kernel directly (head-major) so the post-rope tensors
-            # can be named for the 'flash' remat policy: with q/k/v saved
-            # here and out/lse saved in the kernel's fwd rule, backward
-            # resumes attention AD from residuals instead of replaying
-            # transpose+RoPE+QK-norm+kernel.
-            import importlib
-
-            fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
-            bq, bk = flash_block_sizes(x.shape[1], config.attn_block_size)
-            q = checkpoint_name(q.transpose(0, 2, 1, 3), "q_rot")
-            k = checkpoint_name(k.transpose(0, 2, 1, 3), "k_rot")
-            v = checkpoint_name(v.transpose(0, 2, 1, 3), "v_proj")
-            att = fa.flash_attention(q, k, v, bq, bk)
+        elif flash_ok:
+            att = GPT._call_flash(
+                config,
+                x.shape[1],
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+            )
             att = att.transpose(0, 2, 1, 3)
         else:
             att = multihead_attention(
@@ -379,7 +493,7 @@ class GPT:
                 layout="bthc",
             )
             att = checkpoint_name(att, "attn_out")
-        return att
+        return att, False
 
     @staticmethod
     def hidden(
@@ -495,8 +609,8 @@ class GPT:
         def block_fn(x, block: BlockParams):
             h = rms_norm(x)
             q, k, v = GPT._project_qkv(config, block, h)  # (B, T, H, C)
-            qr = apply_rope_bthc(q, rope[0], rope[1])
-            kr = apply_rope_bthc(k, rope[0], rope[1])
+            qr = apply_rope_bthc(q, rope[0], rope[1], style=config.rope_style)
+            kr = apply_rope_bthc(k, rope[0], rope[1], style=config.rope_style)
             att = multihead_attention(
                 qr, kr, v, impl=config.attn_impl, inference=True,
                 block_size=config.attn_block_size, layout="bthc",
@@ -542,8 +656,12 @@ class GPT:
             block, ck, cv = block_and_cache  # ck, cv: (B, H, S, C)
             h = rms_norm(x)
             q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
-            q = apply_rope_bthc(q, sin, cos, positions).transpose(0, 2, 1, 3)
-            k = apply_rope_bthc(k, sin, cos, positions).transpose(0, 2, 1, 3)
+            q = apply_rope_bthc(
+                q, sin, cos, positions, style=config.rope_style
+            ).transpose(0, 2, 1, 3)
+            k = apply_rope_bthc(
+                k, sin, cos, positions, style=config.rope_style
+            ).transpose(0, 2, 1, 3)
             v = v.transpose(0, 2, 1, 3)  # all (B, H, 1, C)
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))

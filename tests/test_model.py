@@ -77,6 +77,85 @@ def test_attn_impl_parity(params):
     np.testing.assert_allclose(np.asarray(base), np.asarray(blk), atol=2e-5, rtol=2e-5)
 
 
+def test_rope_split_style_exact(params):
+    """rope_style='split' (in-graph q/k row permutation + rotate-half,
+    models/gpt.py _project_qkv) computes the SAME function of the SAME
+    params as the reference interleaved rotation: logits AND grads match.
+    This is what lets perf configs flip the style without touching
+    checkpoints or val-loss parity."""
+    cfg_split = dataclasses.replace(CFG, rope_style="split")
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 32), 0, CFG.vocab_size)
+    labels = (tokens + 1) % CFG.vocab_size
+    l_ref = GPT.apply(CFG, params, tokens, inference=True)
+    l_split = GPT.apply(cfg_split, params, tokens, inference=True)
+    np.testing.assert_allclose(
+        np.asarray(l_ref), np.asarray(l_split), atol=2e-5, rtol=2e-5
+    )
+
+    def loss(cfg, p):
+        return cross_entropy_loss(GPT.apply(cfg, p, tokens, inference=True), labels)
+
+    g_ref = jax.grad(lambda p: loss(CFG, p))(params)
+    g_split = jax.grad(lambda p: loss(cfg_split, p))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_split)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_rope_split_style_decode_consistent(params):
+    """Prefill + decode under rope_style='split' agree with the full
+    forward (the permuted-order keys live in the KV cache; consistent
+    within a run because the style is config-recorded)."""
+    from midgpt_tpu.models.gpt import KVCache
+
+    cfg = dataclasses.replace(CFG, rope_style="split")
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 12), 0, CFG.vocab_size)
+    full = GPT.apply(cfg, params, tokens, inference=True)
+    cache = KVCache.init(cfg, 2, dtype=jnp.float32)
+    logits, cache = GPT.prefill(cfg, params, tokens[:, :8], cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :8]), atol=1e-4, rtol=1e-4
+    )
+    for t in range(8, 12):
+        step_logits, cache = GPT.decode_step(cfg, params, tokens[:, t], cache)
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, t]), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_attn_layout_head_matches_seq(params):
+    """attn_layout='head' (direct (B,H,T,C) projection + fused merge,
+    models/gpt.py) is the same math as the seq layout — logits and grads
+    match on the flash path it accelerates. Uses blockwise impl via attn_fn?
+    No: flash needs TPU; on CPU the head path activates via attn_fn
+    injection, so test through a trivial head-major attn_fn."""
+    from midgpt_tpu.ops.attention import multihead_attention
+
+    # head-major oracle attention fn (what ring/ulysses/flash present)
+    attn_fn = lambda q, k, v: multihead_attention(
+        q, k, v, impl="naive", inference=True, layout="bhtc"
+    )
+    cfg_head = dataclasses.replace(CFG, attn_layout="head")
+    tokens = jax.random.randint(jax.random.PRNGKey(10), (2, 32), 0, CFG.vocab_size)
+    labels = (tokens + 1) % CFG.vocab_size
+
+    def loss(cfg, p, use_fn):
+        h = GPT.hidden(
+            cfg, p, tokens, inference=True, attn_fn=attn_fn if use_fn else None
+        )
+        logits = jnp.einsum("btd,vd->btv", h, p.lm_head)
+        return cross_entropy_loss(logits, labels)
+
+    l_seq, g_seq = jax.value_and_grad(lambda p: loss(CFG, p, False))(params)
+    l_head, g_head = jax.value_and_grad(lambda p: loss(cfg_head, p, True))(params)
+    np.testing.assert_allclose(float(l_head), float(l_seq), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_seq), jax.tree.leaves(g_head)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    # and with split rope on top (the shipped fast-path combination)
+    cfg_both = dataclasses.replace(CFG, attn_layout="head", rope_style="split")
+    l_both = loss(cfg_both, params, True)
+    np.testing.assert_allclose(float(l_both), float(l_seq), rtol=1e-5)
+
+
 def test_grad_flows_everywhere(params):
     tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, CFG.vocab_size)
     labels = jax.random.randint(jax.random.PRNGKey(6), (2, 16), 0, CFG.vocab_size)

@@ -48,6 +48,38 @@ def test_rope_shift_equivariance():
     )
 
 
+def test_split_style_is_permutation_conjugate():
+    """The split lowering computes the SAME rotation as the reference's
+    interleaved form after the C axis is permuted by `split_permutation` —
+    the op-level exactness behind rope_style='split' (models/gpt.py applies
+    the permutation to the q/k projection rows, so QK^T is unchanged)."""
+    from midgpt_tpu.ops.rope import apply_rope_bthc, split_permutation
+
+    key = jax.random.PRNGKey(3)
+    B, T, H, C = 2, 16, 3, 32
+    x = jax.random.normal(key, (B, T, H, C))
+    sin, cos = rope_table(C, T)
+    perm = split_permutation(C)
+    ref = apply_rope_bthc(x, sin, cos, style="interleaved")
+    got = apply_rope_bthc(x[..., perm], sin, cos, style="split")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref[..., perm]), atol=1e-6
+    )
+    # and scores are invariant: q.k == q[perm].k[perm]
+    q, k = x, jnp.roll(x, 1, axis=0)
+    s_ref = jnp.einsum(
+        "bthc,bshc->bhts",
+        apply_rope_bthc(q, sin, cos),
+        apply_rope_bthc(k, sin, cos),
+    )
+    s_split = jnp.einsum(
+        "bthc,bshc->bhts",
+        apply_rope_bthc(q[..., perm], sin, cos, style="split"),
+        apply_rope_bthc(k[..., perm], sin, cos, style="split"),
+    )
+    np.testing.assert_allclose(np.asarray(s_split), np.asarray(s_ref), atol=1e-5)
+
+
 def test_rope_positions_gather():
     """Explicit positions must equal the contiguous-prefix default."""
     key = jax.random.PRNGKey(2)

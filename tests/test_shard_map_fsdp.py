@@ -125,14 +125,22 @@ from midgpt_tpu.utils.hlo import (  # noqa: E402
 )
 
 
-def _fusion_calls_dot(line, comps):
-    """Does this fusion instruction's called computation contain a dot?"""
+def _fusion_calls_dot(line, comps, _seen=None):
+    """Does this fusion instruction's called computation (transitively,
+    through nested fusions/calls) contain a dot?"""
     import re
 
-    m = re.search(r"calls=%([\w.\-]+)", line)
-    if not m or m.group(1) not in comps:
-        return False
-    return any(" dot(" in l for l in comps[m.group(1)])
+    _seen = _seen if _seen is not None else set()
+    for callee in re.findall(r"calls=%([\w.\-]+)", line):
+        if callee in _seen or callee not in comps:
+            continue
+        _seen.add(callee)
+        for inner in comps[callee]:
+            if " dot(" in inner:
+                return True
+            if "calls=%" in inner and _fusion_calls_dot(inner, comps, _seen):
+                return True
+    return False
 
 
 def test_zero3_gathers_schedulable_ahead_of_compute():

@@ -96,22 +96,27 @@ def analyze(txt: str) -> int:
             1 for l in lines if " all-gather(" in l and not is_async(l)
         )
         n_annot = sum(1 for l in lines if is_async(l))
-        cont = [
-            re.search(r'op_name="[^"]*?/(block/[\w,>-]+(?:/[\w,>-]+)?)', l)
-            for l in lines
-            if "calls=%async_collective_fusion" in l
+        cont_lines = [l for l in lines if "calls=%async_collective_fusion" in l]
+        # op_name labels feed the DISPLAY only — the count must not depend
+        # on metadata naming (it drifts across XLA versions).
+        cont_ops = [
+            m.group(1)
+            for m in (
+                re.search(r'op_name="[^"]*?/(block/[\w,>-]+(?:/[\w,>-]+)?)', l)
+                for l in cont_lines
+            )
+            if m
         ]
-        cont_ops = [m.group(1) for m in cont if m]
-        if n_sync + n_annot + len(cont_ops) == 0:
+        if n_sync + n_annot + len(cont_lines) == 0:
             continue  # gather-free body (not a ZeRO-3 layer scan)
         kind = "forward" if is_forward_body(lines) else "backward"
         print(
             f"{kind} scan body {n}: {n_annot} annotated-async gathers, "
-            f"{len(cont_ops)} gathers fused into compute kernels "
+            f"{len(cont_lines)} gathers fused into compute kernels "
             f"(continuation fusions on: {sorted(set(cont_ops))}), "
             f"{n_sync} plain"
         )
-        (bodies_ok if n_annot + len(cont_ops) > 0 else bodies_bad).append(
+        (bodies_ok if n_annot + len(cont_lines) > 0 else bodies_bad).append(
             (kind, n)
         )
     if not bodies_ok and not bodies_bad:
