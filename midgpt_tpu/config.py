@@ -134,7 +134,11 @@ class ExperimentConfig:
                 raise ValueError(f"n_head={mc.n_head} not divisible by mesh.tp={tp}")
             if (4 * mc.n_embd) % tp != 0:
                 raise ValueError(f"4*n_embd={4 * mc.n_embd} not divisible by mesh.tp={tp}")
-            if self.tp_vocab and mc.vocab_size % tp != 0:
+            if self.tp_vocab and mc.vocab_size % tp != 0 and self.mesh.pp in (1, -1):
+                # Under pp the pipeline never vocab-shards (its CE runs on
+                # gathered heads; pipeline_param_specs keeps wte/lm_head
+                # tp-replicated), so tp_vocab is inert there — don't reject
+                # a config the pp x tp path runs correctly.
                 raise ValueError(
                     f"vocab_size={mc.vocab_size} not divisible by mesh.tp={tp} "
                     "(set tp_vocab=False or pad the vocab)"
@@ -149,19 +153,21 @@ class ExperimentConfig:
         if self.pipeline_microbatches < 0:
             raise ValueError(f"pipeline_microbatches={self.pipeline_microbatches} must be >= 0")
         if pp > 1:
-            # v2 GPipe composes with 'data' AND 'fsdp' (parallel/pipeline.py):
-            # stages shard the LAYER axis, stage weights can shard over
-            # 'fsdp'; sp/tp composition is future work.
+            # GPipe composes with 'data', 'fsdp' (v2: stage weights shard,
+            # per-layer gathers in the stage scan) and 'tp' (r5: the
+            # Megatron axes of the stage weights shard over a GSPMD 'auto'
+            # axis inside the pipeline shard_map — parallel/pipeline.py).
+            # sp composition is future work.
             if mc.n_layer % pp != 0:
                 raise ValueError(f"n_layer={mc.n_layer} not divisible by mesh.pp={pp}")
             if mc.dropout != 0.0:
                 raise ValueError("mesh.pp > 1 requires dropout=0.0")
             if self.fsdp_mode != "gspmd":
                 raise ValueError("mesh.pp > 1 requires fsdp_mode='gspmd'")
-            if self.mesh.sp not in (1, -1) or tp != 1:
+            if self.mesh.sp not in (1, -1):
                 raise ValueError(
-                    "mesh.pp > 1 currently composes with 'data' and 'fsdp' "
-                    "only (set sp=1, tp=1)"
+                    "mesh.pp > 1 does not compose with mesh.sp > 1 yet "
+                    "(set sp=1)"
                 )
             if mc.attn_impl in ("ring", "ulysses"):
                 raise ValueError("mesh.pp > 1 does not compose with sequence parallelism yet")

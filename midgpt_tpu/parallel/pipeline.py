@@ -77,15 +77,40 @@ def pipeline_param_specs(
     axis over 'pp'; with a real 'fsdp' mesh axis (and shard_model), large
     leaves additionally shard a non-layer axis over 'fsdp' (the same
     axis-choice rule as parallel/fsdp.py — exact divisibility required,
-    since shard_map hands the body literal shards). Works for params AND
-    optimizer-state trees (path-keyed on 'blocks')."""
+    since shard_map hands the body literal shards). With a real 'tp' axis
+    the four block projections additionally shard their Megatron axis over
+    'tp' (same name->axis table as parallel/tp.py, which the stacked leaves
+    share since both carry the leading L) and fsdp moves to the OTHER
+    feature axis; the embedding/lm_head stay tp-replicated (no
+    vocab-parallel under pp — the pipeline CE runs on gathered heads).
+    Works for params AND optimizer-state trees (path-keyed on 'blocks')."""
     from midgpt_tpu.parallel.fsdp import fsdp_leaf_spec
+    from midgpt_tpu.parallel.tp import _leaf_name, megatron_leaf_axes
 
     n_fsdp = mesh.shape["fsdp"] if mesh is not None else 1
+    n_tp = mesh.shape["tp"] if mesh is not None else 1
 
     def rule(path, x) -> P:
         names = [getattr(e, "name", None) or getattr(e, "key", None) for e in path]
         if "blocks" in names:
+            if n_tp > 1:
+                axes = megatron_leaf_axes(_leaf_name(path), x.shape, n_tp)
+                # Stacked block leaves carry the leading layer axis, so the
+                # Megatron axes (trailing) can never collide with slot 0 —
+                # guarded anyway: fall through to the plain pp+fsdp rule.
+                if axes is not None and 0 not in axes:
+                    tp_ax, fsdp_ax = axes
+                    spec: tp.List[tp.Any] = [None] * x.ndim
+                    spec[0] = "pp"
+                    spec[tp_ax] = "tp"
+                    if (
+                        shard_model
+                        and n_fsdp > 1
+                        and x.size > min_size
+                        and x.shape[fsdp_ax] % n_fsdp == 0
+                    ):
+                        spec[fsdp_ax] = "fsdp"
+                    return P(*spec)
             # layer axis reserved for 'pp'; fsdp picks among the rest
             spec = fsdp_leaf_spec(x, n_fsdp, shard_model, min_size, reserved_leading=1)
             spec[0] = "pp"
@@ -94,6 +119,21 @@ def pipeline_param_specs(
         return P(*spec) if any(e is not None for e in spec) else P()
 
     return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def _strip_tp(spec: P) -> P:
+    """in_specs for the pipeline shard_map mention MANUAL axes only: 'tp'
+    stays a GSPMD ('auto') axis inside the body, its sharding carried by the
+    arrays themselves (make_pipeline_loss)."""
+    def strip(entry):
+        if entry == "tp":
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(e for e in entry if e != "tp")
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return entry
+
+    return P(*(strip(e) for e in spec))
 
 
 def gpipe_stage_apply(
@@ -219,6 +259,26 @@ def make_pipeline_loss(
         return jax.lax.pmean(loss, BATCH_AXES)
 
     batch_spec = P(BATCH_AXES, None)
+    if mesh.shape["tp"] > 1:
+        # tp composition (r5): 'tp' is deliberately NOT a manual axis — the
+        # tick body stays written in pp/fsdp collectives only, while the
+        # Megatron tp schedule rides GSPMD inside it (auto axis), the same
+        # split as the non-pp tp path. in_specs mention only the manual
+        # axes; the params' own shardings carry 'tp' into the body. Gated
+        # on tp>1 because partial-manual shard_map exercises extra GSPMD
+        # machinery (an XLA CPU AllReducePromotion pass crashes on the
+        # full-manual-equivalent program when the auto set is empty-but-
+        # declared — keep the tp=1 path byte-identical to v2).
+        return jax.shard_map(
+            local_loss,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(_strip_tp, param_specs), batch_spec, batch_spec, P()
+            ),
+            out_specs=P(),
+            axis_names=frozenset(mesh.axis_names) - {"tp"},
+            check_vma=False,
+        )
     return jax.shard_map(
         local_loss,
         mesh=mesh,

@@ -55,6 +55,26 @@ _ROW_PARALLEL = {"wo": 1, "w_down": 1}  # input features = axis -1
 _VOCAB_PARALLEL = {"wte": 2, "lm_head": 2}  # vocab axis = axis -2 of (V, D)
 
 
+def megatron_leaf_axes(
+    name: str, shape: tp.Tuple[int, ...], n_tp: int
+) -> tp.Optional[tp.Tuple[int, int]]:
+    """(tp_ax, fsdp_ax) for a Megatron-shardable leaf, or None.
+
+    THE axis-selection rule, shared by tp_param_specs and the pipeline's
+    pp×tp spec rule (parallel/pipeline.py) so the two layouts cannot
+    silently diverge: tp on the column/row-parallel axis per the tables
+    above, fsdp composing on the leaf's OTHER trailing feature axis."""
+    off = _COLUMN_PARALLEL.get(name) or _ROW_PARALLEL.get(name)
+    ndim = len(shape)
+    if off is None or ndim < 2:
+        return None
+    tp_ax = ndim - off
+    if shape[tp_ax] % n_tp != 0:
+        return None
+    fsdp_ax = ndim - 1 if tp_ax == ndim - 2 else ndim - 2
+    return tp_ax, fsdp_ax
+
+
 def _leaf_name(path: tp.Tuple[tp.Any, ...]) -> str:
     """Last attribute-ish component of a pytree path."""
     for entry in reversed(path):
@@ -83,18 +103,16 @@ def tp_param_specs(
 
     def rule(path, x, base_spec):
         name = _leaf_name(path)
-        if name in _COLUMN_PARALLEL:
-            tp_ax = x.ndim - _COLUMN_PARALLEL[name]
-        elif name in _ROW_PARALLEL:
-            tp_ax = x.ndim - _ROW_PARALLEL[name]
-        elif vocab_parallel and name in _VOCAB_PARALLEL:
+        axes = megatron_leaf_axes(name, x.shape, n_tp)
+        if axes is None:
+            if not (vocab_parallel and name in _VOCAB_PARALLEL):
+                return base_spec
             tp_ax = x.ndim - _VOCAB_PARALLEL[name]
+            if x.ndim < 2 or x.shape[tp_ax] % n_tp != 0:
+                return base_spec
+            fsdp_ax = x.ndim - 1 if tp_ax == x.ndim - 2 else x.ndim - 2
         else:
-            return base_spec
-        if x.ndim < 2 or x.shape[tp_ax] % n_tp != 0:
-            return base_spec
-        # fsdp composes on the other trailing (feature) axis
-        fsdp_ax = x.ndim - 1 if tp_ax == x.ndim - 2 else x.ndim - 2
+            tp_ax, fsdp_ax = axes
         spec: tp.List[tp.Any] = [None] * x.ndim
         spec[tp_ax] = "tp"
         if (

@@ -178,6 +178,61 @@ def test_pipeline_fsdp_composition_train_step_matches_oracle():
     np.testing.assert_allclose(evals["pp_fsdp"], evals["oracle"], rtol=1e-5)
 
 
+def test_pipeline_tp_composition_train_step_matches_oracle():
+    """r5 composition: Megatron 'tp' rides a GSPMD auto axis INSIDE the
+    pipeline shard_map (manual axes: data/fsdp/sp/pp only) — the stage
+    weights shard their Megatron axes over 'tp' (pipeline_param_specs), the
+    tick body stays written in pp/fsdp collectives, and GSPMD inserts the
+    tp psums at the block joins. One full train step + eval on a
+    (fsdp=2, tp=2, pp=2) mesh reproduces the FSDP-only oracle."""
+    base = dict(
+        rundir="",
+        data_dir="",
+        learning_rate=1e-2,
+        batch_size=8,
+        warmup_steps=5,
+        min_lr=1e-3,
+        lr_decay_steps=50,
+        max_steps=50,
+        beta2=0.99,
+        weight_decay=1e-4,
+        eval_interval=25,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=2,
+        shard_model=True,
+        fsdp_min_size=0,
+        eval_steps=2,
+        model_config=CFG,
+    )
+    oracle_cfg = ExperimentConfig(mesh=MeshConfig(data=2, fsdp=4, sp=1), **base)
+    pp_tp_cfg = ExperimentConfig(
+        mesh=MeshConfig(data=1, fsdp=2, sp=1, tp=2, pp=2), **base
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, CFG.vocab_size, (2, 8, 32), dtype=np.int32)
+    y = np.roll(x, -1, axis=-1)
+    losses, evals = {}, {}
+    for name, cfg in (("oracle", oracle_cfg), ("pp_tp", pp_tp_cfg)):
+        mesh = make_mesh(cfg.mesh)
+        params, opt_state, specs, optimizer = init_state(cfg, mesh)
+        step, eval_loss, _ = make_train_step(cfg, optimizer, mesh, specs)
+        xg = make_global_batch(x, mesh, batch_spec())
+        yg = make_global_batch(y, mesh, batch_spec())
+        params, _, loss = step(params, opt_state, xg, yg, jax.random.PRNGKey(0))
+        losses[name] = float(loss)
+        evals[name] = float(eval_loss(params, xg[0], yg[0]))
+    np.testing.assert_allclose(losses["pp_tp"], losses["oracle"], rtol=1e-5)
+    np.testing.assert_allclose(evals["pp_tp"], evals["oracle"], rtol=1e-5)
+    # and the stage weights really are tp-sharded (not silently replicated)
+    mesh = make_mesh(pp_tp_cfg.mesh)
+    params, _, specs, _ = init_state(pp_tp_cfg, mesh)
+    assert specs.blocks.attn.wqkv == P("pp", None, "tp", "fsdp")
+    assert specs.blocks.mlp.w_up == P("pp", "tp", "fsdp")
+    assert specs.blocks.mlp.w_down == P("pp", "fsdp", "tp")
+
+
 def test_pipeline_ce_volume_sharded_over_pp():
     """FLOP-level proof the lm_head/CE volume is 1x, not pp x: with a
     CE-dominated shape (V >> L·D), the compiled per-device program must cost
@@ -244,7 +299,8 @@ def test_pipeline_config_validation():
             model_config=dataclasses.replace(CFG, dropout=0.1),
             **kw,
         )
-    # v2: fsdp composes with pp; sp/tp still do not
+    # v2: fsdp composes with pp; r5: tp does too; sp still does not
     ExperimentConfig(mesh=MeshConfig(fsdp=2, pp=2), model_config=CFG, **kw)
-    with pytest.raises(ValueError, match="composes"):
+    ExperimentConfig(mesh=MeshConfig(fsdp=1, tp=2, pp=2), model_config=CFG, **kw)
+    with pytest.raises(ValueError, match="sp"):
         ExperimentConfig(mesh=MeshConfig(fsdp=1, sp=2, pp=2), model_config=CFG, **kw)
