@@ -86,6 +86,10 @@ class ExperimentConfig:
     # pipeline stage). More microbatches shrink the pipeline bubble
     # (pp-1 of M+pp-1 ticks) at the cost of smaller per-tick matmuls.
     pipeline_microbatches: int = 0
+    # 'gpipe' (reverse-AD backward, stash grows with microbatches) or
+    # '1f1b' (interleaved fwd/bwd, 2*pp-slot stash independent of
+    # microbatch count — parallel/pipeline.py make_pipeline_loss_and_grad).
+    pipeline_schedule: str = "gpipe"
     debug: bool = False
 
     def __post_init__(self):
@@ -152,6 +156,16 @@ class ExperimentConfig:
             raise ValueError(f"mesh.pp={pp} must be >= 1 (or -1 to infer)")
         if self.pipeline_microbatches < 0:
             raise ValueError(f"pipeline_microbatches={self.pipeline_microbatches} must be >= 0")
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r} "
+                "('gpipe' or '1f1b')"
+            )
+        if self.pipeline_schedule == "1f1b" and self.mesh.tp not in (1, -1):
+            raise ValueError(
+                "pipeline_schedule='1f1b' does not compose with mesh.tp > 1 "
+                "yet (its backward is hand-written; use 'gpipe')"
+            )
         if pp > 1:
             # GPipe composes with 'data', 'fsdp' (v2: stage weights shard,
             # per-layer gathers in the stage scan) and 'tp' (r5: the

@@ -40,13 +40,25 @@ program every tick; there is no per-stage control flow to trace):
     per-layer grad reduce-scatter as the gather's transpose).
 
 The pipeline bubble is the standard (pp-1)/(M+pp-1) fraction of ticks;
-`pipeline_microbatches` trades bubble against per-tick matmul size. A
-1F1B/interleaved schedule (smaller activation stash at equal bubble) is
-future work — the tick structure accommodates it, the collect logic is the
-part that would change.
+`pipeline_microbatches` trades bubble against per-tick matmul size.
 
-v2 composes with 'data' AND 'fsdp'; sp/tp sharding of the per-stage weights
-is future work (config validation enforces this).
+**1F1B** (`pipeline_schedule='1f1b'`, r5 — make_pipeline_loss_and_grad):
+GPipe's activation stash grows with M (reverse AD of the tick scan saves
+every tick's stage input). The 1F1B schedule bounds it at 2·pp slots,
+M-INDEPENDENT, by running forward and backward in ONE loop — which reverse
+AD cannot express, so the backward is written out: each tick every stage
+does one forward (GPipe timing: F of microbatch m at stage s on tick m+s)
+AND one backward (B of m at stage s on tick m+2·pp-1-s: recompute the
+stage from its stashed INPUT via jax.vjp and pull the incoming cotangent
+through), with bubble ticks masked. The loss stage runs the same
+pp-scattered CE as GPipe per fresh microbatch and seeds the cotangent
+stream; grads accumulate in-loop (blocks per-stage, wte by scatter-add,
+lm_head from the CE pull), so nothing M-sized is ever stored. Memory bound
+and loss/grad parity with GPipe are test-pinned (tests/test_pipeline.py).
+
+Composes with 'data' and 'fsdp' (same per-layer gather streaming; the
+gather's vjp IS the grad reduce-scatter). tp under 1F1B and sp under any
+pipeline schedule are future work (config validation enforces this).
 """
 
 from __future__ import annotations
@@ -284,5 +296,228 @@ def make_pipeline_loss(
         mesh=mesh,
         in_specs=(param_specs, batch_spec, batch_spec, P()),
         out_specs=P(),
+        check_vma=False,
+    )
+
+
+def make_pipeline_loss_and_grad(
+    model_cfg: GPTConfig,
+    mesh: Mesh,
+    param_specs,
+    loss_chunk_tokens: int,
+    loss_remat_chunks: tp.Optional[bool] = None,
+    microbatches: int = 0,
+) -> tp.Callable:
+    """1F1B schedule: loss_and_grad(params, x, y, key) -> (loss, grads).
+
+    Reverse AD of the GPipe tick scan stashes EVERY tick's stage input —
+    O(M) activations per stage. 1F1B interleaves forward and backward in
+    one loop, which AD cannot express, so this function computes loss AND
+    grads directly (the train step calls it instead of value_and_grad;
+    module docstring has the schedule). Tick timing:
+
+      F of microbatch m at stage s:  tick  m + s            (GPipe timing)
+      CE + cotangent seed for m:     tick  m + pp - 1       (its last-stage F)
+      B of microbatch m at stage s:  tick  m + 2*pp - 1 - s
+
+    F at stage s lands on ticks == s (mod 1... both streams run every tick,
+    masked); the stash slot for m is m % (2*pp): F_m is written at tick m+s
+    and read back at tick m+2*pp-1-s, before F_{m+2*pp} rewrites the slot at
+    tick m+2*pp+s — a 2*pp ring buffer regardless of M. B recomputes the
+    stage from the stashed INPUT (jax.vjp), so activation memory is the
+    stash + one in-flight vjp, and the per-layer fsdp gather's vjp emits the
+    grad reduce-scatter exactly as in the GPipe path.
+
+    Gradient bookkeeping (all in-loop, nothing M-sized): block grads
+    accumulate per stage in f32; wte grads scatter-add token rows at stage
+    0's B; lm_head grads accumulate from the CE pull. Final reductions match
+    what shard_map AD inserts for the GPipe path: psum over 'data' (+ the
+    fsdp batch contribution via reduce-scatter), psum over 'pp' for the
+    replicated wte/lm_head, and a 1/(M * n_data * n_fsdp) scale pairing the
+    per-tick cotangent seed (1/pp for the pp-scattered CE slices) with the
+    loss's batch pmean."""
+    pp = mesh.shape["pp"]
+    M = microbatches or pp
+    S = 2 * pp  # stash slots
+    n_batch = mesh.shape["data"] * mesh.shape["fsdp"]
+
+    from midgpt_tpu.parallel.shard_map_fsdp import (
+        _drop_leading,
+        _gather_leaf,
+        _sharded_axis,
+    )
+
+    block_layer_specs = jax.tree.map(_drop_leading, param_specs.blocks)
+
+    def gather_block(block):
+        return jax.tree.map(_gather_leaf, block, block_layer_specs)
+
+    def _reduce_to_spec(g: Array, spec: P) -> Array:
+        """Full (gathered-layout) grad -> sharded layout: sum the fsdp batch
+        shards' contributions and scatter per the param's fsdp axis."""
+        ax = _sharded_axis(spec)
+        if ax is None:
+            return jax.lax.psum(g, "fsdp") if mesh.shape["fsdp"] > 1 else g
+        return jax.lax.psum_scatter(g, "fsdp", scatter_dimension=ax, tiled=True)
+
+    def local_loss_and_grad(params: GPTParams, x: Array, y: Array, key):
+        del key  # dropout 0 under pp (config validation)
+        B, T = x.shape
+        if B % M != 0 or B % pp != 0 or (B // M) % pp != 0:
+            raise ValueError(
+                f"per-data-shard batch {B} must be divisible by "
+                f"pipeline_microbatches={M} (and each microbatch by pp={pp} "
+                "for the scattered CE) — lower them or raise batch_size"
+            )
+        Bm = B // M
+        Bmp = Bm // pp
+        s = jax.lax.axis_index("pp")
+        rope = rope_table(model_cfg.head_dim, T)
+        f32 = jnp.float32
+
+        full_wte = _gather_leaf(params.wte, param_specs.wte)
+        full_head = _gather_leaf(params.lm_head, param_specs.lm_head)
+        x_tok = x.reshape(M, Bm, T)
+        y_mb = y.reshape(M, Bm, T)
+        x_mb = jnp.take(full_wte, x_tok, axis=0)  # (M, Bm, T, D)
+
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        stage_fn = functools.partial(
+            gpipe_stage_apply, model_cfg, rope=rope, layer_transform=gather_block
+        )
+
+        def ce_fn(shard, head, y_slice):
+            hidden = rms_norm(shard, eps=1e-5)
+            return fused_linear_cross_entropy(
+                hidden, head, y_slice, loss_chunk_tokens, loss_remat_chunks
+            )
+
+        act = x_mb[0]
+        gblocks0 = jax.tree.map(lambda p: jnp.zeros(p.shape, f32), params.blocks)
+        carry0 = dict(
+            stash=jnp.zeros((S,) + act.shape, act.dtype),
+            fwd_recv=jnp.zeros_like(act),
+            bwd_recv=jnp.zeros(act.shape, f32),
+            dh_pend=jnp.zeros(act.shape, f32),
+            gblocks=gblocks0,
+            dwte=jnp.zeros(full_wte.shape, f32),
+            dhead=jnp.zeros(full_head.shape, f32),
+            loss=jnp.zeros((), f32),
+        )
+        n_ticks = M + 2 * pp - 1
+
+        def tick(c, t):
+            # ---- forward stream: F of mf = t - s at this stage
+            mf = t - s
+            f_valid = (mf >= 0) & (mf < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            inp = jnp.where(
+                s == 0,
+                jax.lax.dynamic_index_in_dim(x_mb, mf_c, 0, keepdims=False),
+                c["fwd_recv"],
+            )
+            out = stage_fn(params.blocks, inp)
+            slot_f = mf_c % S
+            stash = jax.lax.dynamic_update_index_in_dim(
+                c["stash"],
+                jnp.where(f_valid, inp, c["stash"][slot_f]),
+                slot_f,
+                0,
+            )
+
+            # ---- CE + cotangent seed for the microbatch finishing this tick
+            mf_last = t - (pp - 1)  # uniform scalar across stages
+            ce_valid = (mf_last >= 0) & (mf_last < M)
+            mf_last_c = jnp.clip(mf_last, 0, M - 1)
+            o_ce = jnp.where(s == pp - 1, out, jnp.zeros_like(out))
+            shard = jax.lax.psum_scatter(
+                o_ce, "pp", scatter_dimension=0, tiled=True
+            )  # (Bm/pp, T, D)
+            y_m = jax.lax.dynamic_index_in_dim(y_mb, mf_last_c, 0, keepdims=False)
+            y_slice = jax.lax.dynamic_slice_in_dim(y_m, s * Bmp, Bmp, axis=0)
+            lm, pull_ce = jax.vjp(lambda sh, hd: ce_fn(sh, hd, y_slice), shard, full_head)
+            lm = jax.lax.pmean(lm, "pp")
+            dshard, dhead_m = pull_ce(jnp.asarray(1.0 / pp, lm.dtype))
+            dh_full = jax.lax.all_gather(
+                dshard.astype(f32), "pp", axis=0, tiled=True
+            )  # (Bm, T, D)
+            loss = c["loss"] + jnp.where(ce_valid, lm.astype(f32), 0.0)
+            dhead = c["dhead"] + jnp.where(ce_valid, dhead_m.astype(f32), 0.0)
+
+            # ---- backward stream: B of mb = t - 2*pp + 1 + s at this stage
+            mb = t - 2 * pp + 1 + s
+            b_valid = (mb >= 0) & (mb < M)
+            mb_c = jnp.clip(mb, 0, M - 1)
+            inp_b = c["stash"][mb_c % S]
+            cot = jnp.where(s == pp - 1, c["dh_pend"], c["bwd_recv"])
+            _, pull_stage = jax.vjp(
+                lambda bl, ii: stage_fn(bl, ii), params.blocks, inp_b
+            )
+            dbl, dinp = pull_stage(cot.astype(out.dtype))
+            bm = b_valid.astype(f32)
+            gblocks = jax.tree.map(
+                lambda g, d: g + d.astype(f32) * bm, c["gblocks"], dbl
+            )
+            tok_b = jax.lax.dynamic_index_in_dim(x_tok, mb_c, 0, keepdims=False)
+            dinp32 = dinp.astype(f32) * (bm * (s == 0).astype(f32))
+            dwte = c["dwte"].at[tok_b.reshape(-1)].add(
+                dinp32.reshape(-1, dinp32.shape[-1])
+            )
+
+            # ---- sends
+            new_c = dict(
+                stash=stash,
+                fwd_recv=jax.lax.ppermute(out, "pp", perm_fwd),
+                bwd_recv=jax.lax.ppermute(dinp.astype(f32), "pp", perm_bwd),
+                dh_pend=jnp.where(ce_valid, dh_full, jnp.zeros_like(dh_full)),
+                gblocks=gblocks,
+                dwte=dwte,
+                dhead=dhead,
+                loss=loss,
+            )
+            return new_c, None
+
+        c, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+
+        scale = 1.0 / (M * n_batch)
+        loss = jax.lax.pmean(c["loss"] / M, BATCH_AXES)
+
+        # blocks: the batch shards over BOTH 'data' and 'fsdp'. For
+        # fsdp-SHARDED leaves the gather's vjp already reduce-scattered the
+        # fsdp contributions; fsdp-REPLICATED leaves (below fsdp_min_size,
+        # shard_model=False, or no divisible axis — e.g. q/k scales) still
+        # hold only this rank's batch contribution and need the psum that
+        # shard_map AD inserts for the GPipe path. Then sum the data shards
+        # and apply the loss-mean scale.
+        def block_reduce(g, spec):
+            if mesh.shape["fsdp"] > 1 and _sharded_axis(spec) is None:
+                g = jax.lax.psum(g, "fsdp")
+            if mesh.shape["data"] > 1:
+                g = jax.lax.psum(g, "data")
+            return g * scale
+
+        gblocks = jax.tree.map(block_reduce, c["gblocks"], param_specs.blocks)
+        # wte / lm_head: only stage 0 / the CE contribute (masked), so the
+        # pp-psum collects them; data-psum + fsdp reduce-scatter as above.
+        def emb_reduce(g, spec):
+            g = jax.lax.psum(g, "pp")
+            if mesh.shape["data"] > 1:
+                g = jax.lax.psum(g, "data")
+            return _reduce_to_spec(g, spec) * scale
+
+        grads = GPTParams(
+            wte=emb_reduce(c["dwte"], param_specs.wte),
+            blocks=gblocks,
+            lm_head=emb_reduce(c["dhead"], param_specs.lm_head),
+        )
+        return loss, grads
+
+    batch_spec = P(BATCH_AXES, None)
+    return jax.shard_map(
+        local_loss_and_grad,
+        mesh=mesh,
+        in_specs=(param_specs, batch_spec, batch_spec, P()),
+        out_specs=(P(), param_specs),
         check_vma=False,
     )

@@ -124,14 +124,26 @@ def make_train_step(
             head_axis="tp" if mesh.shape["tp"] > 1 else None,
         )
 
+    loss_and_grad_fn = None  # set only by the 1F1B pipeline schedule
     if mesh.shape["pp"] > 1:
-        from midgpt_tpu.parallel.pipeline import make_pipeline_loss
+        from midgpt_tpu.parallel.pipeline import (
+            make_pipeline_loss,
+            make_pipeline_loss_and_grad,
+        )
 
+        # The GPipe loss serves eval under BOTH schedules (same math,
+        # dropout-free); 1F1B replaces only the value_and_grad of training.
         _pp_loss = make_pipeline_loss(
             model_cfg, mesh, param_specs, config.loss_chunk_tokens,
             config.loss_remat_chunks,
             microbatches=config.pipeline_microbatches,
         )
+        if config.pipeline_schedule == "1f1b":
+            loss_and_grad_fn = make_pipeline_loss_and_grad(
+                model_cfg, mesh, param_specs, config.loss_chunk_tokens,
+                config.loss_remat_chunks,
+                microbatches=config.pipeline_microbatches,
+            )
 
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
             return _pp_loss(params_c, x, y, key)
@@ -175,12 +187,15 @@ def make_train_step(
         params_c = cast_compute(params)
         keys = jax.random.split(key, G)
 
+        value_and_grad = (
+            loss_and_grad_fn
+            if loss_and_grad_fn is not None
+            else jax.value_and_grad(loss_fn)
+        )
         if G == 1:
             # No accumulation machinery: skip the zeros-init + add + divide
             # passes over a full parameter-sized buffer (~3 HBM sweeps).
-            loss, grad = jax.value_and_grad(loss_fn)(
-                params_c, x_GBT[0], y_GBT[0], keys[0]
-            )
+            loss, grad = value_and_grad(params_c, x_GBT[0], y_GBT[0], keys[0])
             grad = constrain(grad, param_specs, mesh)
             grad = jax.tree.map(lambda g, p: g.astype(p.dtype), grad, params)
         else:
@@ -197,7 +212,7 @@ def make_train_step(
 
             def microstep(grad_acc, xyk):
                 x, y, k = xyk
-                loss, grad = jax.value_and_grad(loss_fn)(params_c, x, y, k)
+                loss, grad = value_and_grad(params_c, x, y, k)
                 grad = constrain(grad, param_specs, mesh)
                 grad_acc = jax.tree.map(
                     lambda a, g: a + g.astype(a.dtype) * inv_G, grad_acc, grad
