@@ -120,6 +120,13 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown attn_layout {mc.attn_layout!r} ('seq' or 'head')"
             )
+        if self.fsdp_mode not in ("gspmd", "shard_map"):
+            # A typo would silently run the GSPMD dispatch (train.py
+            # branches on == 'shard_map' else gspmd) — fail at construction
+            # like qkv_proj/rope_style.
+            raise ValueError(
+                f"unknown fsdp_mode {self.fsdp_mode!r} ('gspmd' or 'shard_map')"
+            )
         if mc.dropout > 0.0 and mc.attn_impl != "naive":
             raise ValueError(
                 f"attn_impl={mc.attn_impl!r} does not support attention "
@@ -147,8 +154,16 @@ class ExperimentConfig:
                     f"vocab_size={mc.vocab_size} not divisible by mesh.tp={tp} "
                     "(set tp_vocab=False or pad the vocab)"
                 )
-            if self.fsdp_mode != "gspmd":
-                raise ValueError("mesh.tp > 1 requires fsdp_mode='gspmd'")
+            if self.fsdp_mode == "shard_map":
+                # r5: the explicit ZeRO-3 body composes with tp (auto-axis
+                # GSPMD inside, parallel/shard_map_fsdp.py) — but not yet
+                # together with its sequence-parallel schedules.
+                if self.mesh.sp not in (1, -1) or mc.attn_impl in ("ring", "ulysses"):
+                    raise ValueError(
+                        "fsdp_mode='shard_map' with mesh.tp > 1 does not "
+                        "compose with sequence parallelism yet (set sp=1 "
+                        "and a non-ring/ulysses attn_impl)"
+                    )
         pp = self.mesh.pp
         if pp == -1:
             pp = 1

@@ -646,14 +646,22 @@ class GPT:
 
         Returns (logits (B, V) for the next token, updated cache)."""
         B = token.shape[0]
-        S, C = config.block_size, config.head_dim
+        L, S, C, H = config.n_layer, config.block_size, config.head_dim, config.n_head
         pos = cache.length  # () int32
         x = jnp.take(params.wte, token[:, None], axis=0)  # (B, 1, D)
         sin, cos = rope_table(C, S)
         positions = pos[None]  # (1,)
 
-        def block_fn(x, block_and_cache):
-            block, ck, cv = block_and_cache  # ck, cv: (B, H, S, C)
+        # The cache rides the scan CARRY and is updated by a per-token
+        # COLUMN write. The previous structure (cache as scan xs, new cache
+        # re-stacked from per-layer ys) forced XLA to copy BOTH full
+        # (L, B, H, S, C) buffers every decode step inside the chunked
+        # decode loop — measured 2.5 ms/token of pure copy at 124M/B=8 on
+        # v5e, a third of the whole step (RESULTS §, r5) — plus per-layer
+        # stacked-slot rebuilds. Carry + tiny DUS aliases in place.
+        def block_fn(carry, block_and_idx):
+            x, ck_all, cv_all = carry  # caches (L, B, H, S, C)
+            block, i = block_and_idx
             h = rms_norm(x)
             q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
             q = apply_rope_bthc(
@@ -663,8 +671,18 @@ class GPT:
                 k, sin, cos, positions, style=config.rope_style
             ).transpose(0, 2, 1, 3)
             v = v.transpose(0, 2, 1, 3)  # all (B, H, 1, C)
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+            ck_all = jax.lax.dynamic_update_slice(
+                ck_all, k.astype(ck_all.dtype)[None], (i, 0, 0, pos, 0)
+            )
+            cv_all = jax.lax.dynamic_update_slice(
+                cv_all, v.astype(cv_all.dtype)[None], (i, 0, 0, pos, 0)
+            )
+            ck = jax.lax.dynamic_slice(
+                ck_all, (i, 0, 0, 0, 0), (1, B, H, S, C)
+            )[0]
+            cv = jax.lax.dynamic_slice(
+                cv_all, (i, 0, 0, 0, 0), (1, B, H, S, C)
+            )[0]
             scores = jnp.einsum("bhqc,bhkc->bhqk", q, ck)  # (B, H, 1, S)
             valid = jnp.arange(S)[None, None, None, :] <= pos
             scores = jnp.where(valid, scores, float("-inf"))
@@ -673,9 +691,11 @@ class GPT:
             ).astype(q.dtype)
             att = jnp.einsum("bhqk,bhkc->bhqc", probs, cv)
             x = GPT._attn_out_and_mlp(config, block, x, att.transpose(0, 2, 1, 3))
-            return x, (ck, cv)
+            return (x, ck_all, cv_all), None
 
-        x, (k_new, v_new) = jax.lax.scan(block_fn, x, (params.blocks, cache.k, cache.v))
+        (x, k_new, v_new), _ = jax.lax.scan(
+            block_fn, (x, cache.k, cache.v), (params.blocks, jnp.arange(L))
+        )
         x = rms_norm(x, eps=1e-5)
         logits = jnp.einsum("btd,vd->btv", x, params.lm_head)[:, 0]
         new_cache = KVCache(k=k_new, v=v_new, length=pos + 1)

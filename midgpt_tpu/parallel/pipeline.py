@@ -148,6 +148,27 @@ def _strip_tp(spec: P) -> P:
     return P(*(strip(e) for e in spec))
 
 
+def auto_tp_shard_map_kwargs(mesh: Mesh, param_specs):
+    """(param_in_specs, extra_shard_map_kwargs) for the tp-as-auto-axis
+    composition — ONE definition of the rule, used by the pipeline losses
+    here and the explicit ZeRO-3 body (parallel/shard_map_fsdp.py): with a
+    real 'tp' axis, strip it from in_specs (auto axes may not appear there)
+    and exclude it from the manual axis_names so GSPMD authors the Megatron
+    collectives inside the body; at tp=1 return the specs untouched and no
+    extra kwargs, keeping that path byte-identical to the full-manual form
+    (which also sidesteps an XLA CPU AllReducePromotion CHECK-crash on the
+    partial-manual + bf16 combination)."""
+    if mesh.shape["tp"] > 1:
+        return (
+            jax.tree.map(_strip_tp, param_specs),
+            dict(
+                axis_names=frozenset(mesh.axis_names) - {"tp"},
+                check_vma=False,
+            ),
+        )
+    return param_specs, {}
+
+
 def gpipe_stage_apply(
     config: GPTConfig, stage_blocks, x: Array, rope, layer_transform=None
 ) -> Array:
@@ -271,32 +292,17 @@ def make_pipeline_loss(
         return jax.lax.pmean(loss, BATCH_AXES)
 
     batch_spec = P(BATCH_AXES, None)
-    if mesh.shape["tp"] > 1:
-        # tp composition (r5): 'tp' is deliberately NOT a manual axis — the
-        # tick body stays written in pp/fsdp collectives only, while the
-        # Megatron tp schedule rides GSPMD inside it (auto axis), the same
-        # split as the non-pp tp path. in_specs mention only the manual
-        # axes; the params' own shardings carry 'tp' into the body. Gated
-        # on tp>1 because partial-manual shard_map exercises extra GSPMD
-        # machinery (an XLA CPU AllReducePromotion pass crashes on the
-        # full-manual-equivalent program when the auto set is empty-but-
-        # declared — keep the tp=1 path byte-identical to v2).
-        return jax.shard_map(
-            local_loss,
-            mesh=mesh,
-            in_specs=(
-                jax.tree.map(_strip_tp, param_specs), batch_spec, batch_spec, P()
-            ),
-            out_specs=P(),
-            axis_names=frozenset(mesh.axis_names) - {"tp"},
-            check_vma=False,
-        )
+    # tp composition (r5): 'tp' is deliberately NOT a manual axis — the
+    # tick body stays written in pp/fsdp collectives only, while the
+    # Megatron tp schedule rides GSPMD inside it (auto axis) — see
+    # auto_tp_shard_map_kwargs.
+    in_param_specs, extra = auto_tp_shard_map_kwargs(mesh, param_specs)
     return jax.shard_map(
         local_loss,
         mesh=mesh,
-        in_specs=(param_specs, batch_spec, batch_spec, P()),
+        in_specs=(in_param_specs, batch_spec, batch_spec, P()),
         out_specs=P(),
-        check_vma=False,
+        **dict({"check_vma": False}, **extra),
     )
 
 
@@ -379,7 +385,10 @@ def make_pipeline_loss_and_grad(
         full_head = _gather_leaf(params.lm_head, param_specs.lm_head)
         x_tok = x.reshape(M, Bm, T)
         y_mb = y.reshape(M, Bm, T)
-        x_mb = jnp.take(full_wte, x_tok, axis=0)  # (M, Bm, T, D)
+        # NO up-front (M, Bm, T, D) embedding buffer (GPipe embeds the whole
+        # batch before its scan): stage 0 embeds ONE microbatch per tick
+        # inside the loop, keeping the schedule's memory M-independent —
+        # only the int32 token ids are M-sized.
 
         perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
         perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
@@ -393,13 +402,14 @@ def make_pipeline_loss_and_grad(
                 hidden, head, y_slice, loss_chunk_tokens, loss_remat_chunks
             )
 
-        act = x_mb[0]
+        act_shape = (Bm, T, model_cfg.n_embd)
+        act_dtype = full_wte.dtype
         gblocks0 = jax.tree.map(lambda p: jnp.zeros(p.shape, f32), params.blocks)
         carry0 = dict(
-            stash=jnp.zeros((S,) + act.shape, act.dtype),
-            fwd_recv=jnp.zeros_like(act),
-            bwd_recv=jnp.zeros(act.shape, f32),
-            dh_pend=jnp.zeros(act.shape, f32),
+            stash=jnp.zeros((S,) + act_shape, act_dtype),
+            fwd_recv=jnp.zeros(act_shape, act_dtype),
+            bwd_recv=jnp.zeros(act_shape, f32),
+            dh_pend=jnp.zeros(act_shape, f32),
             gblocks=gblocks0,
             dwte=jnp.zeros(full_wte.shape, f32),
             dhead=jnp.zeros(full_head.shape, f32),
@@ -412,9 +422,10 @@ def make_pipeline_loss_and_grad(
             mf = t - s
             f_valid = (mf >= 0) & (mf < M)
             mf_c = jnp.clip(mf, 0, M - 1)
+            tok_f = jax.lax.dynamic_index_in_dim(x_tok, mf_c, 0, keepdims=False)
             inp = jnp.where(
                 s == 0,
-                jax.lax.dynamic_index_in_dim(x_mb, mf_c, 0, keepdims=False),
+                jnp.take(full_wte, tok_f, axis=0).astype(act_dtype),
                 c["fwd_recv"],
             )
             out = stage_fn(params.blocks, inp)

@@ -149,9 +149,23 @@ def make_shard_map_loss(
         return jax.lax.pmean(loss, loss_axes)
 
     batch_spec = P(BATCH_AXES, "sp" if sequence_parallel else None)
+    # tp composition (r5): same split as the pipeline's pp×tp — 'tp' stays
+    # a GSPMD auto axis, so the authored ZeRO-3 gathers/reduce-scatters
+    # keep riding 'fsdp' while the Megatron column/row schedule (specs from
+    # parallel/tp.py, split3 QKV lowering auto-selected by the runtime) is
+    # inserted by GSPMD inside the body. The kwargs builder
+    # (parallel/pipeline.py auto_tp_shard_map_kwargs, shared) strips 'tp'
+    # from in_specs and the manual axis set only when tp>1 — the tp=1 path
+    # stays byte-identical (the partial-manual form also trips an XLA CPU
+    # AllReducePromotion crash on bf16; config validation keeps
+    # ring/ulysses out of the tp combination for now).
+    from midgpt_tpu.parallel.pipeline import auto_tp_shard_map_kwargs
+
+    in_specs, extra = auto_tp_shard_map_kwargs(mesh, param_specs)
     return jax.shard_map(
         local_loss,
         mesh=mesh,
-        in_specs=(param_specs, batch_spec, batch_spec, P()),
+        in_specs=(in_specs, batch_spec, batch_spec, P()),
         out_specs=P(),
+        **extra,
     )

@@ -1,0 +1,72 @@
+"""Decode throughput bench: KV-cached generation on the real chip.
+
+Measures ms/token of the sampling engine's chunked decode
+(sampling/engine.py) on the 124M shape with random bf16 weights —
+the RESULTS.md inference table's methodology.
+
+Usage: python tools/bench_decode.py [--batch 8] [--tokens 512] [--prompt 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--tokens", type=int, default=512)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--top-k", type=int, default=50)
+    args = p.parse_args()
+
+    from midgpt_tpu.configs.openwebtext import config as base
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.sampling.engine import generate
+
+    cfg = base.model_config
+    params = GPT.init(cfg, jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (args.batch, args.prompt), dtype=np.int32)
+
+    # warmup: 128 new tokens decompose as 1 (prefill) + 64+32+16+8+4+2+1 —
+    # every power-of-two chunk length the engine can dispatch, so no XLA
+    # compile can land inside the timed region below.
+    out = generate(
+        cfg, params, prompt, 128, top_k=args.top_k, key=jax.random.PRNGKey(1)
+    )
+    float(out.ravel()[0].astype(jnp.float32))
+
+    t0 = time.perf_counter()
+    out = generate(
+        cfg, params, prompt, args.tokens, top_k=args.top_k,
+        key=jax.random.PRNGKey(2),
+    )
+    float(out.ravel()[0].astype(jnp.float32))
+    dt = time.perf_counter() - t0
+    ms_tok = 1000 * dt / args.tokens
+    print(
+        f"decode: {ms_tok:.2f} ms/token  "
+        f"({args.batch * args.tokens / dt:,.0f} tok/s total, batch "
+        f"{args.batch}, prompt {args.prompt}, {args.tokens} new)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    main()
