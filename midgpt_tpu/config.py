@@ -31,11 +31,12 @@ class MeshConfig:
     pipeline stages, parallel/pipeline.py) — both 1 unless enabled.
     """
 
-    data: int = -1  # -1: infer as n_devices // (fsdp * sp * tp * pp)
+    data: int = -1  # -1: infer as n_devices // (fsdp * sp * tp * pp * ep)
     fsdp: int = 8
     sp: int = 1
     tp: int = 1  # tensor parallelism (Megatron column/row, parallel/tp.py)
     pp: int = 1  # pipeline parallelism (GPipe over stages, parallel/pipeline.py)
+    ep: int = 1  # expert parallelism (MoE expert axis, models/gpt.py MoEParams)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,6 +210,28 @@ class ExperimentConfig:
                     f"batch_size={self.batch_size} not divisible by "
                     f"pipeline_microbatches={mb}"
                 )
+        ep = self.mesh.ep
+        if ep == -1:
+            ep = 1
+        if mc.n_experts < 0:
+            raise ValueError(f"n_experts={mc.n_experts} must be >= 0")
+        if mc.n_experts > 0:
+            if not (1 <= mc.moe_top_k <= mc.n_experts):
+                raise ValueError(
+                    f"moe_top_k={mc.moe_top_k} must be in [1, n_experts="
+                    f"{mc.n_experts}]"
+                )
+            if pp > 1:
+                raise ValueError(
+                    "MoE (n_experts > 0) does not compose with mesh.pp > 1 yet"
+                )
+        if ep > 1:
+            if mc.n_experts == 0 or mc.n_experts % ep != 0:
+                raise ValueError(
+                    f"mesh.ep={ep} needs n_experts ({mc.n_experts}) divisible by it"
+                )
+            if self.fsdp_mode != "gspmd":
+                raise ValueError("mesh.ep > 1 requires fsdp_mode='gspmd'")
         sp = self.mesh.sp
         if sp == -1:
             sp = 1

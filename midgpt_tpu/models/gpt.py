@@ -110,6 +110,10 @@ class GPTConfig:
     #            124M step was relayout copies, RESULTS §4a).
     # The naive/blockwise reference paths always use 'seq'.
     attn_layout: str = "seq"
+    # Mixture-of-experts MLP (MoEParams): 0 = dense (reference semantics);
+    # E > 0 replaces every block's MLP with E experts, top-k routed.
+    n_experts: int = 0
+    moe_top_k: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -151,9 +155,26 @@ class MLPParams:
 
 
 @pytree_dataclass
+class MoEParams:
+    """Top-k routed MLP (n_experts > 0) — beyond the reference's capability
+    set (its MLP is dense only, reference model.py:17-31). Expert weights
+    carry a leading E axis that shards over the mesh 'ep' axis
+    (parallel/tp.py): each ep shard computes ITS experts for all tokens and
+    the combine contraction psums over E — expert-sharded compute with no
+    token dispatch (the right EP schedule for the masked-dense lowering
+    below; an all-to-all token-dispatch form is the large-E upgrade path).
+    At n_experts=1 the routed MLP is exactly the dense MLP (gate softmax
+    over one expert is 1.0) — parity pinned by tests/test_moe.py."""
+
+    router: Array  # (E, D) — token -> expert logits, x @ router.T
+    experts_up: Array  # (E, 4D, D)
+    experts_down: Array  # (E, D, 4D)
+
+
+@pytree_dataclass
 class BlockParams:
     attn: AttentionParams
-    mlp: MLPParams
+    mlp: tp.Union[MLPParams, MoEParams]  # MoEParams iff config.n_experts > 0
     # Block RMSNorms are weightless (reference model.py:94-95): no leaves.
 
 
@@ -245,10 +266,25 @@ class GPT:
                 q_scale=jnp.ones((C,)),
                 k_scale=jnp.ones((C,)),
             )
-            mlp = MLPParams(
-                w_up=_linear_init(k_up, 4 * D, D),
-                w_down=_linear_init(k_down, D, 4 * D),
-            )
+            if config.n_experts > 0:
+                E = config.n_experts
+                k_router, k_up, k_down = jax.random.split(k_up, 3)
+                up = jax.vmap(lambda kk: _linear_init(kk, 4 * D, D))(
+                    jax.random.split(k_up, E)
+                )
+                down = jax.vmap(lambda kk: _linear_init(kk, D, 4 * D))(
+                    jax.random.split(k_down, E)
+                )
+                mlp = MoEParams(
+                    router=_linear_init(k_router, E, D),
+                    experts_up=up,
+                    experts_down=down,
+                )
+            else:
+                mlp = MLPParams(
+                    w_up=_linear_init(k_up, 4 * D, D),
+                    w_down=_linear_init(k_down, D, 4 * D),
+                )
             return BlockParams(attn=attn, mlp=mlp)
 
         blocks = jax.vmap(init_block)(jax.random.split(block_key, config.n_layer))
@@ -359,10 +395,36 @@ class GPT:
         att = dropout(att, config.dropout, k_resid, inference)
         x = x + att
         h = rms_norm(x)
-        h = jax.nn.gelu(jnp.einsum("btd,ed->bte", h, block.mlp.w_up))
-        h = jnp.einsum("bte,de->btd", h, block.mlp.w_down)
+        if config.n_experts > 0:
+            h = GPT._moe_mlp(config, block.mlp, h)
+        else:
+            h = jax.nn.gelu(jnp.einsum("btd,ed->bte", h, block.mlp.w_up))
+            h = jnp.einsum("bte,de->btd", h, block.mlp.w_down)
         h = dropout(h, config.dropout, k_mlp, inference)
         return x + h
+
+    @staticmethod
+    def _moe_mlp(config: GPTConfig, mlp: "MoEParams", h: Array) -> Array:
+        """Top-k routed expert MLP, masked-dense lowering.
+
+        out = sum_e gate_e(h) * down_e(gelu(up_e(h))) with gates from a
+        top-k-masked softmax over router logits (fp32, like attention's
+        softmax). The gate folds into `up` (down_e is linear), so the only
+        E-sized activation is the (B, T, E, 4D) up buffer — sharded over
+        'ep' along E when expert parallelism is on; the combine einsum's E
+        contraction is the EP all-reduce GSPMD inserts. FLOPs are E/top_k x
+        a dense MLP in this lowering (fine for the small-E regime;
+        token-dispatch all-to-all is the large-E upgrade path)."""
+        E = config.n_experts
+        K = min(config.moe_top_k, E)
+        logits = jnp.einsum("btd,ed->bte", h, mlp.router).astype(jnp.float32)
+        if K < E:
+            kth = jax.lax.top_k(logits, K)[0][..., -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        gates = jax.nn.softmax(logits, axis=-1).astype(h.dtype)  # (B, T, E)
+        up = jax.nn.gelu(jnp.einsum("btd,efd->btef", h, mlp.experts_up))
+        up = up * gates[..., None]
+        return jnp.einsum("btef,edf->btd", up, mlp.experts_down)
 
     @staticmethod
     def block_apply(

@@ -23,7 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.config import MeshConfig
 
-AXES = ("data", "fsdp", "sp", "tp", "pp")
+AXES = ("data", "fsdp", "sp", "tp", "pp", "ep")
 # The axes token batches shard over (batch_spec below; the shard_map loss
 # bodies pmean/fold-in over these).
 BATCH_AXES = ("data", "fsdp")
@@ -41,18 +41,22 @@ def make_mesh(
     sp = cfg.sp if cfg.sp != -1 else 1
     tp_ = cfg.tp if cfg.tp != -1 else 1
     pp = cfg.pp if cfg.pp != -1 else 1
-    if n % (fsdp * sp * tp_ * pp) != 0:
+    ep = cfg.ep if cfg.ep != -1 else 1
+    rest_axes = sp * tp_ * pp * ep
+    if n % (fsdp * rest_axes) != 0:
         # Degrade gracefully on small device counts (e.g. 1-chip dev boxes):
-        # clamp fsdp to the largest divisor of n // (sp * tp * pp).
-        if n % (sp * tp_ * pp) != 0:
-            raise ValueError(f"{n} devices not divisible by sp={sp} * tp={tp_} * pp={pp}")
-        rest = n // (sp * tp_ * pp)
+        # clamp fsdp to the largest divisor of n // (sp * tp * pp * ep).
+        if n % rest_axes != 0:
+            raise ValueError(
+                f"{n} devices not divisible by sp={sp} * tp={tp_} * pp={pp} * ep={ep}"
+            )
+        rest = n // rest_axes
         fsdp = max(d for d in range(1, rest + 1) if rest % d == 0 and d <= fsdp)
-    data = cfg.data if cfg.data != -1 else n // (fsdp * sp * tp_ * pp)
-    if data * fsdp * sp * tp_ * pp != n:
-        raise ValueError(f"mesh {data}x{fsdp}x{sp}x{tp_}x{pp} != {n} devices")
+    data = cfg.data if cfg.data != -1 else n // (fsdp * rest_axes)
+    if data * fsdp * rest_axes != n:
+        raise ValueError(f"mesh {data}x{fsdp}x{sp}x{tp_}x{pp}x{ep} != {n} devices")
     mesh_devices = mesh_utils.create_device_mesh(
-        (data, fsdp, sp, tp_, pp), devices=np.asarray(devices)
+        (data, fsdp, sp, tp_, pp, ep), devices=np.asarray(devices)
     )
     return Mesh(mesh_devices, axis_names=AXES)
 

@@ -53,6 +53,9 @@ from midgpt_tpu.parallel.fsdp import fsdp_param_specs
 _COLUMN_PARALLEL = {"wqkv": 2, "w_up": 2}  # output features = axis -2
 _ROW_PARALLEL = {"wo": 1, "w_down": 1}  # input features = axis -1
 _VOCAB_PARALLEL = {"wte": 2, "lm_head": 2}  # vocab axis = axis -2 of (V, D)
+# MoE expert leaves (models/gpt.py MoEParams): the E axis sits after the
+# stacked layer axis — axis 1 of (L, E, ...). Sharded over 'ep'.
+_EXPERT_PARALLEL = ("experts_up", "experts_down")
 
 
 def megatron_leaf_axes(
@@ -97,12 +100,30 @@ def tp_param_specs(
     (parallel/fsdp.py) everywhere else. With mesh tp=1 this IS the FSDP rule."""
     n_tp = mesh.shape["tp"]
     n_fsdp = mesh.shape["fsdp"]
+    n_ep = mesh.shape["ep"]
     base = fsdp_param_specs(params, mesh, shard_model, min_size)
-    if n_tp == 1:
+    if n_tp == 1 and n_ep == 1:
         return base
 
     def rule(path, x, base_spec):
         name = _leaf_name(path)
+        if n_ep > 1 and name in _EXPERT_PARALLEL:
+            # stacked (L, E, feat, feat): 'ep' on the expert axis, fsdp
+            # composing on the trailing feature axis when it divides.
+            if x.ndim >= 3 and x.shape[1] % n_ep == 0:
+                spec: tp.List[tp.Any] = [None] * x.ndim
+                spec[1] = "ep"
+                if (
+                    shard_model
+                    and n_fsdp > 1
+                    and x.size > min_size
+                    and x.shape[-1] % n_fsdp == 0
+                ):
+                    spec[-1] = "fsdp"
+                return P(*spec)
+            return base_spec
+        if n_tp == 1:
+            return base_spec
         axes = megatron_leaf_axes(name, x.shape, n_tp)
         if axes is None:
             if not (vocab_parallel and name in _VOCAB_PARALLEL):

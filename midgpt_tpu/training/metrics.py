@@ -29,7 +29,15 @@ def flops_per_token(cfg: GPTConfig, seq_len: tp.Optional[int] = None) -> float:
     12*L*D*T attention-scores term (PaLM appendix B accounting)."""
     T = seq_len or cfg.block_size
     D, L, V = cfg.n_embd, cfg.n_layer, cfg.vocab_size
-    n_params = V * D + L * (12 * D * D + 2 * cfg.head_dim) + V * D
+    if cfg.n_experts > 0:
+        # ACTIVE-expert accounting (the MoE convention): top_k expert MLPs
+        # + the router per token. The masked-dense lowering EXECUTES all E
+        # experts, so reported MFU under-counts by E/top_k there — honest
+        # for the useful-FLOPs metric.
+        mlp = min(cfg.moe_top_k, cfg.n_experts) * 8 * D * D + cfg.n_experts * D
+    else:
+        mlp = 8 * D * D
+    n_params = V * D + L * (4 * D * D + mlp + 2 * cfg.head_dim) + V * D
     # Count the tied embedding once, like reference count_params (model.py:161).
     n_params -= V * D
     return 6.0 * n_params + 12.0 * L * D * T

@@ -21,11 +21,11 @@ def test_devices_available():
 
 def test_make_mesh_shapes():
     mesh = make_mesh(MeshConfig(data=-1, fsdp=4, sp=1))
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 4, "sp": 1, "tp": 1, "pp": 1}
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 4, "sp": 1, "tp": 1, "pp": 1, "ep": 1}
     mesh = make_mesh(MeshConfig(data=2, fsdp=2, sp=2))
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sp": 2, "tp": 1, "pp": 1}
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sp": 2, "tp": 1, "pp": 1, "ep": 1}
     mesh = make_mesh(MeshConfig(data=2, fsdp=2, sp=1, tp=2))
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sp": 1, "tp": 2, "pp": 1}
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sp": 1, "tp": 2, "pp": 1, "ep": 1}
 
 
 def test_make_mesh_clamps_fsdp_on_small_counts():
