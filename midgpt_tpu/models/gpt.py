@@ -714,13 +714,17 @@ class GPT:
         sin, cos = rope_table(C, S)
         positions = pos[None]  # (1,)
 
-        # The cache rides the scan CARRY and is updated by a per-token
-        # COLUMN write. The previous structure (cache as scan xs, new cache
-        # re-stacked from per-layer ys) forced XLA to copy BOTH full
-        # (L, B, H, S, C) buffers every decode step inside the chunked
-        # decode loop — measured 2.5 ms/token of pure copy at 124M/B=8 on
-        # v5e, a third of the whole step (RESULTS §, r5) — plus per-layer
-        # stacked-slot rebuilds. Carry + tiny DUS aliases in place.
+        # The cache is threaded through an UNROLLED layer loop and updated
+        # by a per-token COLUMN write. The r1-r4 structure (cache as scan
+        # xs, new cache re-stacked from per-layer ys) forced XLA to copy
+        # BOTH full (L, B, H, S, C) buffers every decode step inside the
+        # chunked decode loop — measured 2.5 ms/token of pure copy at
+        # 124M/B=8 on v5e, a third of the whole step (RESULTS §, r5) —
+        # plus per-layer stacked-slot rebuilds. A rolled scan still pays 2
+        # full-cache copies/step at the inner/outer carry boundary
+        # (verified on compiled HLO); the unrolled DUS chain rides the
+        # decode loop's carry and aliases in place. L is static and small,
+        # so the unroll is cheap to trace (decode has no remat concerns).
         def block_fn(carry, block_and_idx):
             x, ck_all, cv_all = carry  # caches (L, B, H, S, C)
             block, i = block_and_idx
@@ -755,9 +759,11 @@ class GPT:
             x = GPT._attn_out_and_mlp(config, block, x, att.transpose(0, 2, 1, 3))
             return (x, ck_all, cv_all), None
 
-        (x, k_new, v_new), _ = jax.lax.scan(
-            block_fn, (x, cache.k, cache.v), (params.blocks, jnp.arange(L))
-        )
+        carry = (x, cache.k, cache.v)
+        for i in range(L):
+            layer = jax.tree.map(lambda a: a[i], params.blocks)
+            carry, _ = block_fn(carry, (layer, jnp.asarray(i)))
+        x, k_new, v_new = carry
         x = rms_norm(x, eps=1e-5)
         logits = jnp.einsum("btd,vd->btv", x, params.lm_head)[:, 0]
         new_cache = KVCache(k=k_new, v=v_new, length=pos + 1)
