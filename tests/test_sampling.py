@@ -192,3 +192,46 @@ def test_top_p_nucleus():
         a = sample_logits(logits, jax.random.PRNGKey(seed), 1.0, top_p=1.0)
         b = sample_logits(logits, jax.random.PRNGKey(seed), 1.0)
         assert int(a[0]) == int(b[0])
+
+
+def test_decode_chunk_has_no_in_loop_cache_copies():
+    """Structural pin of the r5 decode restructure: inside the chunked
+    decode loop, NO full-cache-sized copy may appear — the per-token column
+    writes must alias through the loop carry. The r1-r4 structure (cache as
+    inner-scan xs + stacked ys) copied both (L, B, H, S, C) buffers every
+    token (2.5 ms/token measured on v5e at 124M/B=8); a rolled inner layer
+    scan still paid 2 copies/step at the carry boundary. One-time entry
+    copies outside the loop are allowed."""
+    import re
+
+    from midgpt_tpu.sampling import engine
+    from midgpt_tpu.utils.hlo import hlo_computations, while_body_names
+
+    cfg = GPTConfig(
+        block_size=256, vocab_size=96, n_layer=4, n_head=2, n_embd=64
+    )
+    B, L, H, S, C = 4, cfg.n_layer, cfg.n_head, cfg.block_size, cfg.head_dim
+    abstract = jax.eval_shape(lambda k: GPT.init(cfg, k), jax.random.PRNGKey(0))
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, jnp.bfloat16), abstract
+    )
+    cache = jax.eval_shape(lambda: KVCache.init(cfg, B))
+    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    fn = jax.jit(
+        lambda p, t, c, k: engine._decode_chunk(cfg, p, t, c, 1.0, 50, None, 8, k)
+    )
+    txt = fn.lower(abstract, tok, cache, key).compile().as_text()
+    bodies = while_body_names(txt)
+    shape = re.escape(f"bf16[{L},{B},{H},{S},{C}]")
+    offenders = [
+        (name, l)
+        for name, lines in hlo_computations(txt).items()
+        if name in bodies
+        for l in lines
+        if re.search(rf"= {shape}[^=]*copy\(", l)
+    ]
+    assert not offenders, (
+        "full-cache copies inside the decode loop body — the KV cache no "
+        f"longer aliases through the carry: {offenders[:2]}"
+    )
