@@ -86,7 +86,9 @@ def main() -> None:
     if os.environ.get("MIDGPT_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["MIDGPT_PLATFORM"])
         if os.environ.get("MIDGPT_CPU_DEVICES"):
-            jax.config.update("jax_num_cpu_devices", int(os.environ["MIDGPT_CPU_DEVICES"]))
+            from midgpt_tpu.utils.compat import set_cpu_device_count
+
+            set_cpu_device_count(int(os.environ["MIDGPT_CPU_DEVICES"]))
 
     if args.multihost:
         jax.distributed.initialize()

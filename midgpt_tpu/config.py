@@ -79,6 +79,13 @@ class ExperimentConfig:
     # chooses collectives (reference parity); 'shard_map' = explicit per-layer
     # all-gather / grad reduce-scatter (parallel/shard_map_fsdp.py).
     fsdp_mode: str = "gspmd"
+    # MoE router load-balance auxiliary loss (Switch Transformer eq. 4-6):
+    # training loss becomes CE + moe_aux_coef * aux, with aux the
+    # layer-mean of E * sum_e P_e * f_e (models/gpt.py _moe_gates). 0.0
+    # (default) keeps the loss byte-identical to the pre-knob path — the
+    # aux computation is never requested, so XLA never sees it (zero-impact
+    # pin in tests/test_moe.py). Switch uses 1e-2.
+    moe_aux_coef: float = 0.0
     # With mesh.tp > 1: also shard wte/lm_head's vocab axis over 'tp'
     # (Megatron vocab-parallel embedding + CE, parallel/tp.py). No effect at
     # tp=1.
@@ -209,6 +216,21 @@ class ExperimentConfig:
                 raise ValueError(
                     f"batch_size={self.batch_size} not divisible by "
                     f"pipeline_microbatches={mb}"
+                )
+        if self.moe_aux_coef != 0.0:
+            if mc.n_experts == 0:
+                raise ValueError(
+                    f"moe_aux_coef={self.moe_aux_coef} needs a routed MLP "
+                    "(n_experts > 0)"
+                )
+            if self.fsdp_mode != "gspmd" or self.mesh.pp not in (1, -1):
+                # The aux term threads through GPT.hidden(return_moe_aux=True),
+                # which only the implicit-GSPMD loss calls; the shard_map and
+                # pipeline bodies have their own layer loops. Fail loudly
+                # instead of silently training without balance pressure.
+                raise ValueError(
+                    "moe_aux_coef requires fsdp_mode='gspmd' and mesh.pp == 1 "
+                    "(the aux term is only folded into the implicit-GSPMD loss)"
                 )
         ep = self.mesh.ep
         if ep == -1:

@@ -35,5 +35,9 @@ config = ExperimentConfig(
         dropout=0.0,
         attn_impl="ring",
         rope_style="split",  # same-function fast RoPE (see openwebtext.py)
+        # 32 layers: the unrolled decode DUS chain costs O(n_layer)
+        # trace+compile per decode chunk length; take the rolled scan's
+        # 2 cache copies/step instead (GPTConfig.decode_layer_scan).
+        decode_layer_scan=True,
     ),
 )

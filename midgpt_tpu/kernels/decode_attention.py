@@ -1,0 +1,201 @@
+"""Pallas TPU paged decode attention for the continuous-batching engine.
+
+Decode-time attention reads K/V through a per-slot PAGE TABLE instead of a
+contiguous (B, S, ...) cache: physical pages of `page_size` tokens live in a
+shared (H, num_pages, page_size, C) pool (models/gpt.py PagedKVCache), and
+slot b's logical page j is pool page `page_table[b, j]`. Each slot masks to
+its own true length, so one compiled program serves any mix of request
+lengths — the two levers the serving layer needs (vLLM-style paged memory +
+FlashAttention-style work partitioning, PAPERS.md) under XLA's static-shape
+constraint.
+
+Kernel structure: grid (B, max_pages), pages innermost/sequential. The page
+table and per-slot lengths ride `PrefetchScalarGridSpec` scalar prefetch, so
+the K/V BlockSpec index maps translate (slot, logical page) -> physical page
+BEFORE the DMA is issued: each grid step pulls exactly one (page_size, C)
+page per head into VMEM — never the whole pool. Online-softmax running
+statistics live in VMEM scratch across the page sweep (same scheme as
+kernels/flash_attention.py, whose finite MASK/M_INIT constants this reuses).
+Pages at or past a slot's length are predicated off with `pl.when` (compute
+skipped; the block DMA still runs — it reads the reserved sink page or a
+stale page, both masked).
+
+Blocks obey the Mosaic tiling rule (CLAUDE.md): the K/V block's last two
+dims are (page_size, C) with page_size 8-divisible and C spanning the full
+head dim; the q/o blocks span (H, C) fully.
+
+Off-TPU the dispatcher uses the XLA gather fallback below, which mirrors the
+contiguous `GPT.decode_step` attention op-for-op (same einsum shapes, same
+mask-then-scale-then-f32-softmax order) so paged decode stays token-exact
+with the single-request engine on the CPU test mesh; the kernel itself runs
+in interpret mode only under its parity test (tests/test_decode_attention.py
+— interpret is too slow for the serving tests' inner loop).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from midgpt_tpu.kernels.flash_attention import M_INIT, MASK, _interpret
+
+Array = jax.Array
+
+# lane width of the m/l statistics scratch (see flash_attention._STATS_LANES)
+_STATS_LANES = 8
+
+
+def _decode_kernel(
+    pt_ref,  # (B, max_pages) int32 scalar-prefetch: page table
+    len_ref,  # (B,) int32 scalar-prefetch: per-slot valid lengths
+    q_ref,  # (1, H, C)
+    k_ref,  # (H, 1, page_size, C)
+    v_ref,  # (H, 1, page_size, C)
+    o_ref,  # (1, H, C)
+    acc_sc,  # (H, C) f32
+    m_sc,  # (H, _STATS_LANES) f32
+    l_sc,  # (H, _STATS_LANES) f32
+    *,
+    scale: float,
+    page_size: int,
+):
+    b, p = pl.program_id(0), pl.program_id(1)
+    n_p = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, M_INIT)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    length = len_ref[b]
+
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0]  # (H, C)
+        k = k_ref[:, 0]  # (H, page_size, C)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (H, page_size) f32
+        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < length, s, MASK)
+
+        m_prev = m_sc[:, 0]  # (H,)
+        l_prev = l_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        prob = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
+        l_new = l_prev * alpha + jnp.sum(prob, axis=-1)
+        pv = jax.lax.dot_general(
+            prob.astype(v_ref.dtype), v_ref[:, 0],
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (H, C)
+        acc_sc[:] = acc_sc[:] * alpha[:, None] + pv
+        m_sc[:] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = l_sc[:, 0]
+        safe_l = jnp.maximum(l, 1e-30)  # length-0 slots emit 0, not NaN
+        o_ref[0] = (acc_sc[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(
+    q: Array,  # (B, H, C) — one query token per slot
+    k_pages: Array,  # (H, num_pages, page_size, C) — ONE layer's pool
+    v_pages: Array,
+    page_table: Array,  # (B, max_pages) int32
+    lengths: Array,  # (B,) int32 — valid tokens per slot (0 = inactive)
+) -> Array:
+    """Paged decode attention via the Pallas kernel. Returns (B, H, C)."""
+    B, H, C = q.shape
+    _, _, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    scale = 1.0 / math.sqrt(C)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
+            pl.BlockSpec(
+                (H, 1, page_size, C), lambda b, p, pt, ln: (0, pt[b, p], 0, 0)
+            ),
+            pl.BlockSpec(
+                (H, 1, page_size, C), lambda b, p, pt, ln: (0, pt[b, p], 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, C), jnp.float32),
+            pltpu.VMEM((H, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((H, _STATS_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, C), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def paged_attention_gather(
+    q: Array,  # (B, H, C)
+    k_pages: Array,  # (H, num_pages, page_size, C)
+    v_pages: Array,
+    page_table: Array,  # (B, max_pages) int32
+    lengths: Array,  # (B,) int32
+) -> Array:
+    """XLA fallback: gather each slot's pages contiguous, then run the exact
+    attention ops of the contiguous `GPT.decode_step` (same einsum shapes,
+    -inf mask BEFORE the 1/sqrt(C)-scaled f32 softmax) so paged and
+    contiguous decode agree token-for-token on CPU. O(B * max_pages) page
+    reads per call — the kernel above is the O(used-length) path on TPU."""
+    B, H, C = q.shape
+    _, _, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    S = max_pages * page_size
+    flat = page_table.reshape(-1)
+    kg = jnp.take(k_pages, flat, axis=1)  # (H, B*max_pages, page_size, C)
+    kg = kg.reshape(H, B, S, C).transpose(1, 0, 2, 3)  # (B, H, S, C)
+    vg = jnp.take(v_pages, flat, axis=1).reshape(H, B, S, C).transpose(1, 0, 2, 3)
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q[:, :, None], kg)  # (B, H, 1, S)
+    valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
+    scores = jnp.where(valid, scores, float("-inf"))
+    probs = jax.nn.softmax(
+        scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+    ).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkc->bhqc", probs, vg)[:, :, 0]
+
+
+def paged_attention(
+    q: Array,
+    k_pages: Array,
+    v_pages: Array,
+    page_table: Array,
+    lengths: Array,
+    impl: str = "auto",
+) -> Array:
+    """Dispatch: Pallas kernel on TPU, XLA gather elsewhere (interpret mode
+    is orders of magnitude too slow for the serving loop — same policy as
+    ops/attention.py for the flash kernel)."""
+    if impl == "auto":
+        impl = "kernel" if jax.default_backend() == "tpu" else "gather"
+    if impl == "kernel":
+        return paged_attention_kernel(q, k_pages, v_pages, page_table, lengths)
+    if impl == "gather":
+        return paged_attention_gather(q, k_pages, v_pages, page_table, lengths)
+    raise ValueError(f"unknown paged attention impl {impl!r}")

@@ -44,6 +44,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 names the Mosaic params class TPUCompilerParams; same fields
+    # (midgpt_tpu.utils.compat documents the shim policy).
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 # Finite stand-ins for -inf (see module docstring).
 MASK = -1.0e30
 M_INIT = -0.5e30

@@ -114,6 +114,18 @@ class GPTConfig:
     # E > 0 replaces every block's MLP with E experts, top-k routed.
     n_experts: int = 0
     moe_top_k: int = 2
+    # Decode-time layer loop lowering (decode_step / decode_step_paged):
+    #   False — Python-unrolled DUS chain: the KV cache aliases through the
+    #           decode loop carry with ZERO full-cache copies per token
+    #           (the r5 restructure, pinned by test_sampling.py), but the
+    #           decode program size and trace/compile time grow linearly
+    #           with n_layer — fine at 12 layers, noticeably slower to
+    #           compile per chunk length at the 32-layer 7B shapes.
+    #   True  — rolled lax.scan over layers: O(1) compile in depth, at the
+    #           measured cost of 2 full-cache copies per decode step at the
+    #           inner/outer carry boundary (RESULTS §1 r5). The deep
+    #           llama7b configs set this.
+    decode_layer_scan: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -210,6 +222,60 @@ class KVCache:
             v=jnp.zeros(shape, dtype),
             length=jnp.zeros((), jnp.int32),
         )
+
+
+@pytree_dataclass
+class PagedKVCache:
+    """Paged decode cache for the continuous-batching serving engine.
+
+    K/V live in a shared pool of fixed-size pages, (n_layer, n_head,
+    num_pages, page_size, head_dim) per tensor, and a request occupies
+    whatever pages the host-side allocator (sampling/serve.py PageAllocator)
+    hands it — so device memory holds O(sum of used lengths) instead of
+    `n_slots * block_size` (the KVCache sizing above). Page 0 is the SINK:
+    never allocated, it is what unallocated page-table entries (zeros) point
+    at, so inactive/short slots READ it — always masked — while writes from
+    inactive slots and pad positions are dropped via out-of-range page
+    indices (XLA oob-scatter semantics; decode_step_paged /
+    prefill_paged_chunk).
+
+    The page table ((n_slots, max_pages) int32) and per-slot lengths are NOT
+    part of this pytree: they are host-managed scheduler state passed into
+    each serve step, so one compiled program serves any request mix — only
+    the pool rides the jit carry (donated, updated in place; the
+    no-full-cache-copies pin in tests/test_sampling.py covers it).
+
+    page_size must be a multiple of 8 and head_dim a multiple of 128 — or
+    span the full dim — for the Mosaic decode kernel's BlockSpec tiling
+    (kernels/decode_attention.py); the XLA gather fallback has no such
+    constraint."""
+
+    k: Array  # (n_layer, n_head, num_pages, page_size, head_dim)
+    v: Array
+
+    @staticmethod
+    def init(
+        config: "GPTConfig",
+        num_pages: int,
+        page_size: int = 8,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (
+            config.n_layer,
+            config.n_head,
+            num_pages,
+            page_size,
+            config.head_dim,
+        )
+        return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[2]
 
 
 def _remat_policy(name: str):
@@ -378,8 +444,12 @@ class GPT:
         k_mlp: tp.Optional[KeyArray] = None,
         inference: bool = True,
         head_major: bool = False,
-    ) -> Array:
-        """Shared tail of a block: merge heads, output proj, MLP, residuals."""
+        return_moe_aux: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, Array]]:
+        """Shared tail of a block: merge heads, output proj, MLP, residuals.
+
+        With return_moe_aux (routed MLP only), returns (out, aux) where aux
+        is the block's scalar load-balance term (_moe_gates)."""
         if head_major:
             # Merge + output projection as ONE contraction: wo's input axis
             # decomposes as (H, C) in the merged order, so this equals
@@ -395,36 +465,86 @@ class GPT:
         att = dropout(att, config.dropout, k_resid, inference)
         x = x + att
         h = rms_norm(x)
+        aux = None
         if config.n_experts > 0:
-            h = GPT._moe_mlp(config, block.mlp, h)
+            if return_moe_aux:
+                h, aux = GPT._moe_mlp(config, block.mlp, h, return_aux=True)
+            else:
+                h = GPT._moe_mlp(config, block.mlp, h)
         else:
             h = jax.nn.gelu(jnp.einsum("btd,ed->bte", h, block.mlp.w_up))
             h = jnp.einsum("bte,de->btd", h, block.mlp.w_down)
         h = dropout(h, config.dropout, k_mlp, inference)
-        return x + h
+        out = x + h
+        return (out, aux) if return_moe_aux else out
 
     @staticmethod
-    def _moe_mlp(config: GPTConfig, mlp: "MoEParams", h: Array) -> Array:
+    def _moe_gates(
+        config: GPTConfig, mlp: "MoEParams", h: Array
+    ) -> tp.Tuple[Array, Array]:
+        """Router -> (gates (B, T, E) in h.dtype, load-balance aux () f32).
+
+        Top-k selection goes through `jax.lax.top_k` INDICES, not a
+        `logits >= kth` threshold: threshold masking admits MORE than k
+        experts on exact logit ties — in the degenerate all-equal-logits
+        state (a zero or collapsed router) every expert passes and routing
+        silently turns dense (ADVICE r5). The index scatter keeps exactly k
+        per token always (ties broken by lowest expert index,
+        deterministic); for tie-free logits the masked set is identical, so
+        gates are unchanged. Pinned by tests/test_moe.py.
+
+        aux is the Switch-style load-balance term (Switch Transformer
+        eq. 4-6, PAPERS.md): E * sum_e P_e * f_e with P_e the mean FULL
+        softmax prob of expert e over tokens and f_e the mean top-k
+        assignment fraction (divided by k so sum_e f_e = 1). Balanced
+        routing gives exactly 1.0; a collapsed router approaches E/k * k
+        terms -> > 1. It is differentiable through P_e only (f_e is a hard
+        count), which is what makes it push probability mass toward
+        under-assigned experts. Dead code (freely eliminated) unless the
+        caller requests it — training folds it in behind
+        ExperimentConfig.moe_aux_coef."""
+        E = config.n_experts
+        K = min(config.moe_top_k, E)
+        logits = jnp.einsum("btd,ed->bte", h, mlp.router).astype(jnp.float32)
+        probs_full = jax.nn.softmax(logits, axis=-1)  # (B, T, E) f32
+        if K < E:
+            idx = jax.lax.top_k(logits, K)[1]  # (B, T, K)
+            assign = jnp.any(
+                jax.nn.one_hot(idx, E, dtype=jnp.bool_), axis=-2
+            )  # (B, T, E): exactly K True per token
+            logits = jnp.where(assign, logits, -jnp.inf)
+        else:
+            assign = jnp.ones(logits.shape, jnp.bool_)
+        gates = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        mean_prob = jnp.mean(probs_full, axis=(0, 1))  # (E,)
+        mean_assign = jnp.mean(assign.astype(jnp.float32), axis=(0, 1)) / K
+        aux = E * jnp.sum(mean_prob * mean_assign)
+        return gates, aux
+
+    @staticmethod
+    def _moe_mlp(
+        config: GPTConfig,
+        mlp: "MoEParams",
+        h: Array,
+        return_aux: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, Array]]:
         """Top-k routed expert MLP, masked-dense lowering.
 
         out = sum_e gate_e(h) * down_e(gelu(up_e(h))) with gates from a
         top-k-masked softmax over router logits (fp32, like attention's
-        softmax). The gate folds into `up` (down_e is linear), so the only
-        E-sized activation is the (B, T, E, 4D) up buffer — sharded over
-        'ep' along E when expert parallelism is on; the combine einsum's E
-        contraction is the EP all-reduce GSPMD inserts. FLOPs are E/top_k x
-        a dense MLP in this lowering (fine for the small-E regime;
-        token-dispatch all-to-all is the large-E upgrade path)."""
-        E = config.n_experts
-        K = min(config.moe_top_k, E)
-        logits = jnp.einsum("btd,ed->bte", h, mlp.router).astype(jnp.float32)
-        if K < E:
-            kth = jax.lax.top_k(logits, K)[0][..., -1:]
-            logits = jnp.where(logits >= kth, logits, -jnp.inf)
-        gates = jax.nn.softmax(logits, axis=-1).astype(h.dtype)  # (B, T, E)
+        softmax — selection semantics in _moe_gates). The gate folds into
+        `up` (down_e is linear), so the only E-sized activation is the
+        (B, T, E, 4D) up buffer — sharded over 'ep' along E when expert
+        parallelism is on; the combine einsum's E contraction is the EP
+        all-reduce GSPMD inserts. FLOPs are E/top_k x a dense MLP in this
+        lowering (fine for the small-E regime; token-dispatch all-to-all is
+        the large-E upgrade path). With return_aux, also returns the
+        scalar load-balance term."""
+        gates, aux = GPT._moe_gates(config, mlp, h)
         up = jax.nn.gelu(jnp.einsum("btd,efd->btef", h, mlp.experts_up))
         up = up * gates[..., None]
-        return jnp.einsum("btef,edf->btd", up, mlp.experts_down)
+        out = jnp.einsum("btef,edf->btd", up, mlp.experts_down)
+        return (out, aux) if return_aux else out
 
     @staticmethod
     def block_apply(
@@ -437,7 +557,8 @@ class GPT:
         rope: tp.Optional[tp.Tuple[Array, Array]] = None,
         positions: tp.Optional[Array] = None,
         attn_fn: tp.Optional[tp.Callable[[Array, Array, Array], Array]] = None,
-    ) -> Array:
+        return_moe_aux: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, Array]]:
         C = config.head_dim
         if rope is None:
             rope = rope_table(C, x.shape[1])
@@ -456,6 +577,7 @@ class GPT:
             return GPT._attn_out_and_mlp(
                 config, params, x, att, k_resid=k_resid, k_mlp=k_mlp,
                 inference=inference, head_major=head_major,
+                return_moe_aux=return_moe_aux,
             )
 
     @staticmethod
@@ -569,8 +691,15 @@ class GPT:
         attn_fn: tp.Optional[tp.Callable[[Array, Array, Array], Array]] = None,
         positions: tp.Optional[Array] = None,
         rope_len: tp.Optional[int] = None,
-    ) -> Array:
+        return_moe_aux: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, Array]]:
         """Backbone forward -> final-normed hidden states (B, T, D).
+
+        `return_moe_aux` (routed MLP configs only) additionally returns the
+        MoE load-balance term averaged over layers — a () f32 scalar the
+        training loss folds in as `moe_aux_coef * aux`
+        (ExperimentConfig.moe_aux_coef). Off by default, so the aux
+        computation is dead code in every other caller.
 
         `positions` (shape (T,), absolute) + `rope_len` (static table length
         covering the largest position) let a sequence-parallel caller run the
@@ -613,27 +742,33 @@ class GPT:
         # global sequence when T is a local shard of it
         rope = rope_table(C, rope_len or T)
 
+        if return_moe_aux and config.n_experts == 0:
+            raise ValueError("return_moe_aux requires a routed MLP (n_experts > 0)")
+
         def block_fn(x, block_and_key):
             block, k = block_and_key
             if layer_transform is not None:
                 block = layer_transform(block)
             with jax.named_scope("block"):
-                return (
-                    GPT.block_apply(
-                        config, block, x, key=k, inference=inference, rope=rope,
-                        positions=positions, attn_fn=attn_fn,
-                    ),
-                    None,
+                out = GPT.block_apply(
+                    config, block, x, key=k, inference=inference, rope=rope,
+                    positions=positions, attn_fn=attn_fn,
+                    return_moe_aux=return_moe_aux,
                 )
+            # ys carry the per-layer aux scalar only when requested, so the
+            # default path's scan signature (and its compiled HLO) is
+            # unchanged.
+            return out if return_moe_aux else (out, None)
 
         if config.remat:
             block_fn = jax.checkpoint(block_fn, policy=_remat_policy(config.remat_policy))
-        x, _ = jax.lax.scan(
+        x, aux = jax.lax.scan(
             block_fn, x, (params.blocks, layer_keys), unroll=config.scan_unroll
         )
 
         with jax.named_scope("final_norm"):
-            return rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
+            x = rms_norm(x, eps=1e-5)  # final norm (reference model.py:133,156)
+        return (x, jnp.mean(aux)) if return_moe_aux else x
 
     @staticmethod
     def apply(
@@ -759,15 +894,232 @@ class GPT:
             x = GPT._attn_out_and_mlp(config, block, x, att.transpose(0, 2, 1, 3))
             return (x, ck_all, cv_all), None
 
-        carry = (x, cache.k, cache.v)
-        for i in range(L):
-            layer = jax.tree.map(lambda a: a[i], params.blocks)
-            carry, _ = block_fn(carry, (layer, jnp.asarray(i)))
+        carry = GPT._decode_layer_loop(config, block_fn, (x, cache.k, cache.v), params.blocks)
         x, k_new, v_new = carry
         x = rms_norm(x, eps=1e-5)
         logits = jnp.einsum("btd,vd->btv", x, params.lm_head)[:, 0]
         new_cache = KVCache(k=k_new, v=v_new, length=pos + 1)
         return logits, new_cache
+
+    @staticmethod
+    def _decode_layer_loop(config: GPTConfig, block_fn, carry, blocks):
+        """Drive `block_fn(carry, (layer_params, layer_idx))` over all layers.
+
+        Two lowerings, selected by `config.decode_layer_scan` (trade-off
+        documented on the config field):
+
+          * Python unroll (default) — the KV cache buffers thread straight
+            through the unrolled DUS chain, so inside a chunked decode loop
+            they alias the loop carry with ZERO full-cache copies per token
+            (the r5 restructure; structural pin in tests/test_sampling.py).
+            Cost: the traced decode program is O(n_layer) ops — at 12
+            layers that is noise, at the 32-layer 7B shapes each chunk
+            length costs noticeably more trace+compile time.
+          * Rolled `lax.scan` — O(1) program size in depth (one traced
+            block), at the measured cost of 2 full-cache copies per decode
+            step at the inner/outer scan carry boundary (RESULTS §1 r5:
+            XLA cannot alias a while-loop carry into an enclosing loop's
+            carry slot). The deep llama7b configs set this: for them,
+            compile latency dominates interactive use and the copies are
+            amortized by the much larger per-layer compute.
+
+        Both run the SAME block_fn (layer index arrives as a traced scalar
+        either way), so the two lowerings cannot drift numerically — pinned
+        by the decode_layer_scan parity test in tests/test_sampling.py."""
+        if config.decode_layer_scan:
+            idx = jnp.arange(config.n_layer)
+            carry, _ = jax.lax.scan(block_fn, carry, (blocks, idx))
+            return carry
+        for i in range(config.n_layer):
+            layer = jax.tree.map(lambda a: a[i], blocks)
+            carry, _ = block_fn(carry, (layer, jnp.asarray(i)))
+        return carry
+
+    # ------------------------------------------------------------------
+    # Paged decoding (continuous-batching serving engine, sampling/serve.py)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def decode_step_paged(
+        config: GPTConfig,
+        params: GPTParams,
+        token: Array,  # (B,) int — each slot's newest token
+        cache: "PagedKVCache",
+        page_table: Array,  # (B, max_pages) int32 — logical -> physical page
+        lengths: Array,  # (B,) int32 — tokens already in slot b's cache
+        active: Array,  # (B,) bool — False: slot is empty / mid-prefill
+        attn_impl: str = "auto",
+    ) -> tp.Tuple[Array, "PagedKVCache"]:
+        """One decode step for B independent requests at B different positions.
+
+        Slot b writes its token's K/V at logical position lengths[b] (page
+        page_table[b, lengths[b] // page_size], in-page offset lengths[b] %
+        page_size) and attends to its own lengths[b] + 1 valid tokens through
+        the page table — the paged counterpart of `decode_step`, with the
+        SAME per-layer op order (project, per-position RoPE, column write,
+        mask-then-f32-softmax attention), so the two agree token-for-token
+        (parity pin in tests/test_sampling.py). Inactive slots (empty or
+        mid-prefill) have their writes DROPPED (redirected out of range —
+        their page rows may hold real prefilled K/V) and attend to a single
+        garbage key, producing finite logits the scheduler ignores.
+
+        The layer loop goes through `_decode_layer_loop` (decode_layer_scan
+        applies). Attention dispatches per `attn_impl` — 'auto' is the
+        Pallas page-table kernel on TPU, the XLA gather fallback elsewhere
+        (kernels/decode_attention.py).
+
+        Returns (logits (B, V), cache with the B new K/V columns written)."""
+        from midgpt_tpu.kernels.decode_attention import paged_attention
+        from midgpt_tpu.ops.rope import apply_rope_positions
+
+        B = token.shape[0]
+        C = config.head_dim
+        ps = cache.page_size
+        pos = lengths  # (B,) write positions
+        active_i = active.astype(jnp.int32)
+        # Valid keys per slot: the just-written token makes it lengths + 1
+        # for active slots; inactive slots get 1 (the sink page's slot 0) so
+        # the gather fallback's softmax never sees an all-masked row (NaN).
+        attn_counts = jnp.maximum(active_i * (pos + 1), 1)
+        # Inactive slots must not write at all — their page-table row is
+        # real scheduler state (a mid-prefill slot's pages hold its already
+        # prefilled K/V, which a sink-style write at position 0 would
+        # corrupt). Redirect them past the pool so the scatter drops them.
+        write_pages = jnp.where(
+            active,
+            jnp.take_along_axis(page_table, (pos // ps)[:, None], axis=1)[:, 0],
+            cache.num_pages,
+        )  # (B,)
+        offs = pos % ps
+        x = jnp.take(params.wte, token[:, None], axis=0)  # (B, 1, D)
+        sin, cos = rope_table(C, config.block_size)
+        positions = pos[:, None]  # (B, 1) — per-slot absolute positions
+
+        def block_fn(carry, block_and_idx):
+            x, ck_all, cv_all = carry  # pools (L, H, P, ps, C)
+            block, i = block_and_idx
+            h = rms_norm(x)
+            q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
+            q = apply_rope_positions(q, sin, cos, positions, style=config.rope_style)
+            k = apply_rope_positions(k, sin, cos, positions, style=config.rope_style)
+            q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]  # (B, H, C)
+            # Advanced-indexing scatter: one (B,)-indexed column write per
+            # pool — i/write_pages/offs are the advanced indices (result
+            # dims (B, H, C) lead), the H and C axes ride as slices. In the
+            # decode loop carry this lowers to an in-place scatter, not a
+            # pool copy (pinned).
+            ck_all = ck_all.at[i, :, write_pages, offs, :].set(
+                k1.astype(ck_all.dtype)
+            )
+            cv_all = cv_all.at[i, :, write_pages, offs, :].set(
+                v1.astype(cv_all.dtype)
+            )
+            kp = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
+            vp = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
+            att = paged_attention(
+                q1, kp, vp, page_table, attn_counts, impl=attn_impl
+            )  # (B, H, C)
+            x = GPT._attn_out_and_mlp(config, block, x, att[:, None])
+            return (x, ck_all, cv_all), None
+
+        carry = GPT._decode_layer_loop(
+            config, block_fn, (x, cache.k, cache.v), params.blocks
+        )
+        x, k_new, v_new = carry
+        x = rms_norm(x, eps=1e-5)
+        logits = jnp.einsum("btd,vd->btv", x, params.lm_head)[:, 0]
+        return logits, PagedKVCache(k=k_new, v=v_new)
+
+    @staticmethod
+    def prefill_paged_chunk(
+        config: GPTConfig,
+        params: GPTParams,
+        tokens: Array,  # (1, T_c) int — one request's prompt chunk, padded
+        start: Array,  # () int32 — absolute position of tokens[0, 0]
+        n_valid: Array,  # () int32 — real tokens in this chunk (rest is pad)
+        cache: "PagedKVCache",
+        page_table: Array,  # (1, max_pages) int32
+    ) -> tp.Tuple[Array, "PagedKVCache"]:
+        """Prefill ONE request's prompt chunk [start, start + n_valid) into
+        its pages, attending causally to the chunk itself plus everything
+        the slot already holds ([0, start) — earlier chunks).
+
+        Chunking is what lets the scheduler interleave long-prompt admission
+        with running decodes: each serve round spends at most T_c prompt
+        tokens of work before the batch decodes again (docs/SERVING.md).
+        T_c is static — the engine pads the tail chunk and passes n_valid;
+        pad positions are redirected to an out-of-range page index so the
+        scatter DROPS them (XLA oob-scatter semantics) instead of clobbering
+        allocated pages, and pad logits are garbage the caller ignores.
+
+        Attention here is an XLA gather path only: the slot's pages are
+        gathered contiguous ONCE per layer and all T_c chunk rows attend to
+        that buffer under per-row length masks (the Pallas decode kernel's
+        one-query-row online-softmax shape doesn't fit a chunk — a
+        chunked-prefill kernel is the TPU upgrade path, docs/SERVING.md).
+
+        Returns (logits (1, T_c, V), updated cache)."""
+        _, T_c = tokens.shape
+        C = config.head_dim
+        ps = cache.page_size
+        t_idx = jnp.arange(T_c, dtype=jnp.int32)
+        positions = start + t_idx  # (T_c,)
+        valid = t_idx < n_valid
+        # Pad writes go out of range -> dropped by the scatter.
+        write_pages = jnp.where(
+            valid,
+            jnp.take(page_table[0], positions // ps, axis=0),
+            cache.num_pages,
+        )
+        offs = positions % ps
+        x = jnp.take(params.wte, tokens, axis=0)  # (1, T_c, D)
+        sin, cos = rope_table(C, config.block_size)
+        # The chunk attends to attn_count = start + t + 1 keys at row t; pad
+        # rows clamp to the last valid count (their output is discarded).
+        attn_counts = jnp.minimum(positions, start + n_valid - 1) + 1  # (T_c,)
+
+        def block_fn(carry, block_and_idx):
+            x, ck_all, cv_all = carry
+            block, i = block_and_idx
+            h = rms_norm(x)
+            q, k, v = GPT._project_qkv(config, block, h)  # (1, T_c, H, C)
+            qr = apply_rope_bthc(q, sin, cos, positions, style=config.rope_style)
+            kr = apply_rope_bthc(k, sin, cos, positions, style=config.rope_style)
+            # kr[0]/v[0] are (T_c, H, C) — the advanced-index scatter's
+            # broadcast dims (i scalar x write_pages x offs -> (T_c,)) lead,
+            # H and C ride as slices, so that's the update shape verbatim.
+            ck_all = ck_all.at[i, :, write_pages, offs, :].set(
+                kr[0].astype(ck_all.dtype)
+            )
+            cv_all = cv_all.at[i, :, write_pages, offs, :].set(
+                v[0].astype(cv_all.dtype)
+            )
+            kp = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
+            vp = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
+            # Gather the slot's pages contiguous ONCE; every chunk row
+            # attends to the same buffer under its own length mask (same
+            # mask-then-scale-then-f32-softmax order as decode_step).
+            H = config.n_head
+            S = page_table.shape[1] * ps
+            kg = jnp.take(kp, page_table[0], axis=1).reshape(H, S, C)
+            vg = jnp.take(vp, page_table[0], axis=1).reshape(H, S, C)
+            scores = jnp.einsum("thc,hsc->hts", qr[0].astype(kg.dtype), kg)
+            ok = jnp.arange(S)[None, None, :] < attn_counts[None, :, None]
+            scores = jnp.where(ok, scores, float("-inf"))
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+            ).astype(kg.dtype)
+            att = jnp.einsum("hts,hsc->thc", probs, vg)  # (T_c, H, C)
+            x = GPT._attn_out_and_mlp(config, block, x, att[None].astype(x.dtype))
+            return (x, ck_all, cv_all), None
+
+        carry = GPT._decode_layer_loop(
+            config, block_fn, (x, cache.k, cache.v), params.blocks
+        )
+        x, k_new, v_new = carry
+        x = rms_norm(x, eps=1e-5)
+        logits = jnp.einsum("btd,vd->btv", x, params.lm_head)
+        return logits, PagedKVCache(k=k_new, v=v_new)
 
     @staticmethod
     def count_params(params: GPTParams) -> int:

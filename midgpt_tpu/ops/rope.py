@@ -68,6 +68,31 @@ def apply_rope(
     return x * cos + rotate_interleaved(x) * sin
 
 
+def apply_rope_positions(
+    x: Array,
+    sin: Array,
+    cos: Array,
+    positions: Array,
+    style: str = "interleaved",
+) -> Array:
+    """Rotate `x` (B, T, H, C) with PER-TOKEN absolute positions (B, T).
+
+    The continuous-batching decode path runs B independent requests at B
+    different write positions in one step; `apply_rope_bthc` broadcasts one
+    (T,) position vector over the batch, this gathers a (B, T) table slice
+    instead. Same elementwise rotation, so for equal positions it is
+    bit-identical to `apply_rope_bthc` (pinned by tests/test_rope.py)."""
+    sin = jnp.take(sin, positions, axis=0)  # (B, T, C/2)
+    cos = jnp.take(cos, positions, axis=0)
+    if style == "split":
+        sin = _tile_halves(sin).astype(x.dtype)[:, :, None, :]  # (B, T, 1, C)
+        cos = _tile_halves(cos).astype(x.dtype)[:, :, None, :]
+        return x * cos + rotate_half(x) * sin
+    sin = _duplicate_pairs(sin).astype(x.dtype)[:, :, None, :]
+    cos = _duplicate_pairs(cos).astype(x.dtype)[:, :, None, :]
+    return x * cos + rotate_interleaved(x) * sin
+
+
 def rotate_half(x: Array) -> Array:
     """[a b | c d] -> [-c -d | a b] over the trailing axis (contiguous
     halves — the TPU-friendly form: two static slices instead of the

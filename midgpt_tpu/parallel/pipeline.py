@@ -75,6 +75,7 @@ from midgpt_tpu.ops.norms import rms_norm
 from midgpt_tpu.ops.rope import rope_table
 from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 from midgpt_tpu.parallel.mesh import BATCH_AXES
+from midgpt_tpu.utils.compat import shard_map
 
 Array = jax.Array
 
@@ -297,7 +298,7 @@ def make_pipeline_loss(
     # Megatron tp schedule rides GSPMD inside it (auto axis) — see
     # auto_tp_shard_map_kwargs.
     in_param_specs, extra = auto_tp_shard_map_kwargs(mesh, param_specs)
-    return jax.shard_map(
+    return shard_map(
         local_loss,
         mesh=mesh,
         in_specs=(in_param_specs, batch_spec, batch_spec, P()),
@@ -525,7 +526,7 @@ def make_pipeline_loss_and_grad(
         return loss, grads
 
     batch_spec = P(BATCH_AXES, None)
-    return jax.shard_map(
+    return shard_map(
         local_loss_and_grad,
         mesh=mesh,
         in_specs=(param_specs, batch_spec, batch_spec, P()),

@@ -59,6 +59,7 @@ import importlib
 # the real module (the kernels package re-exports a same-named function)
 fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
 from midgpt_tpu.ops.attention import flash_block_sizes
+from midgpt_tpu.utils.compat import axis_size, shard_map
 
 Array = jax.Array
 
@@ -274,7 +275,7 @@ def ring_attention(
 
 def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
     use_kernel, block_size = _resolve_pair_plan(q.shape[2], block_size, use_kernel)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     g = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -312,7 +313,7 @@ def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
 def _ring_bwd(axis_name, block_size, use_kernel, residuals, do):
     q, k, v, out, lse = residuals
     use_kernel, block_size = _resolve_pair_plan(q.shape[2], block_size, use_kernel)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     g = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -380,7 +381,7 @@ def ring_attention_sharded(
     ring over its own H/tp heads' T/sp shard."""
     spec = P(batch_axes, head_axis, axis_name, None)
     # nondiff_argnums of a custom_vjp function must be passed positionally
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name, block_size, use_kernel),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -51,6 +51,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from midgpt_tpu.models.gpt import GPT, GPTParams
 from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 from midgpt_tpu.parallel.mesh import BATCH_AXES
+from midgpt_tpu.utils.compat import axis_size, shard_map
 
 Array = jax.Array
 
@@ -118,7 +119,7 @@ def make_shard_map_loss(
         positions = rope_len = attn_fn = None
         if sequence_parallel:
             Tl = x.shape[1]
-            rope_len = Tl * jax.lax.axis_size("sp")
+            rope_len = Tl * axis_size("sp")
             positions = jax.lax.axis_index("sp") * Tl + jnp.arange(Tl)
             if sequence_parallel == "ring":
                 from midgpt_tpu.parallel.ring_attention import ring_attention
@@ -162,7 +163,7 @@ def make_shard_map_loss(
     from midgpt_tpu.parallel.pipeline import auto_tp_shard_map_kwargs
 
     in_specs, extra = auto_tp_shard_map_kwargs(mesh, param_specs)
-    return jax.shard_map(
+    return shard_map(
         local_loss,
         mesh=mesh,
         in_specs=(in_specs, batch_spec, batch_spec, P()),

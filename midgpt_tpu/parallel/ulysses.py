@@ -38,6 +38,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from midgpt_tpu.ops.attention import multihead_attention
+from midgpt_tpu.utils.compat import axis_size, shard_map
 
 Array = jax.Array
 
@@ -54,7 +55,7 @@ def ulysses_attention(
 
     Shards are contiguous sequence chunks in axis order (what sharding the
     T axis over `axis_name` produces); heads must divide the axis size."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n > 1:
         if q.shape[1] % n != 0:
             # ValueError (not assert): direct callers bypass the
@@ -100,7 +101,7 @@ def ulysses_attention_sharded(
     (B, H, T, C) result with the same layout. `impl` selects the inner dense
     attention ('flash' kernel-dispatched; 'blockwise'/'naive' for debug)."""
     spec = P(batch_axes, head_axis, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, axis_name, block_size, impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),

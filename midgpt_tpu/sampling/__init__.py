@@ -1,3 +1,4 @@
 from midgpt_tpu.sampling.engine import generate
+from midgpt_tpu.sampling.serve import ServeEngine
 
-__all__ = ["generate"]
+__all__ = ["generate", "ServeEngine"]

@@ -1,0 +1,477 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+`engine.generate` serves ONE fixed batch: every request starts together,
+pads to the longest prompt, and the whole batch runs until the last request
+finishes — a tail of dead slots, and a (B, S)-sized cache however short the
+requests are. This module serves a STREAM: requests are admitted into decode
+slots the moment one frees (or a new one arrives), long prompts prefill in
+bounded chunks interleaved with the running batch's decode steps, and K/V
+live in a shared paged pool sized to the expected working set instead of
+`n_slots * block_size` (models/gpt.py PagedKVCache).
+
+Scheduling is host-side and runs every round (`ServeEngine.step`):
+
+  1. **Admit** — waiting requests claim free slots (FCFS). Admission needs
+     only enough free pages for the FIRST prefill chunk; later pages are
+     allocated lazily as the request grows.
+  2. **Prefill** — ONE waiting slot advances its prompt by at most
+     `prefill_chunk` tokens (GPT.prefill_paged_chunk), so a 30k-token
+     prompt costs each running generation at most one chunk of extra
+     latency per round instead of stalling the batch for the whole prompt
+     (the chunked-prefill lever, Sarathi/vLLM-style, adapted to XLA static
+     shapes: the chunk is padded to a fixed width, so ONE compiled program
+     serves every chunk of every prompt).
+  3. **Decode** — all generating slots step together as one device program:
+     a power-of-two-sized chain of `GPT.decode_step_paged` calls
+     (`_serve_decode_chunk`, same dispatch-amortization scheme as
+     engine.generate's DECODE_CHUNK, bounded compile set
+     {decode_chunk, decode_chunk/2, ..., 1}). Page tables and lengths are
+     plain jit inputs — admitting/finishing requests never recompiles.
+
+When the pool runs dry, the scheduler EVICTS the youngest running slot
+(frees its pages, pushes the request back to the queue front with its
+generated tokens folded into the prompt — recompute-style preemption), so
+the oldest requests always make progress and the engine never deadlocks.
+
+Greedy (temperature=0) serving is token-for-token identical to
+`engine.generate` on the same prompt (parity pin in tests/test_sampling.py);
+stochastic sampling draws from a different key stream (per-chunk splits per
+slot batch) and is only distributionally equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, PagedKVCache
+from midgpt_tpu.sampling.engine import sample_logits
+
+Array = jax.Array
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5,))
+def _serve_prefill_chunk(config, params, tokens, start, n_valid, cache, page_table_row):
+    return GPT.prefill_paged_chunk(
+        config, params, tokens, start, n_valid, cache, page_table_row
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11), donate_argnums=(3,)
+)
+def _serve_decode_chunk(
+    config,
+    params,
+    token,  # (B,) int32
+    cache,  # PagedKVCache (donated)
+    page_table,  # (B, max_pages) int32
+    lengths,  # (B,) int32
+    active,  # (B,) bool
+    n_steps: int,
+    temperature: float,
+    top_k,
+    top_p,
+    attn_impl: str,
+    key=None,
+):
+    """n_steps decode+sample steps for the whole slot batch as ONE device
+    program. Inactive slots hold their token and length (their writes land
+    on the sink page). Returns (cache, tokens (n_steps, B))."""
+
+    def body(carry, _):
+        token, cache, lengths, key = carry
+        if key is not None:
+            key, k = jax.random.split(key)
+        else:
+            k = None
+        logits, cache = GPT.decode_step_paged(
+            config, params, token, cache, page_table, lengths, active,
+            attn_impl=attn_impl,
+        )
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            nxt = sample_logits(logits, k, temperature, top_k, top_p)
+        nxt = jnp.where(active, nxt.astype(token.dtype), token)
+        lengths = lengths + active.astype(lengths.dtype)
+        return (nxt, cache, lengths, key), nxt
+
+    (_, cache, _, _), toks = jax.lax.scan(
+        body, (token, cache, lengths, key), None, length=n_steps
+    )
+    return cache, toks
+
+
+class PageAllocator:
+    """Free-list allocator over the pool's pages. Page 0 is the SINK
+    (absorbs inactive-slot writes, models/gpt.py PagedKVCache) and is never
+    handed out."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, ...
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> tp.Optional[tp.List[int]]:
+        """n pages, or None (allocator unchanged) if the pool is short."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: tp.Iterable[int]) -> None:
+        for p in pages:
+            assert 0 < p < self.num_pages
+            self._free.append(p)
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: np.ndarray  # (T0,) int32
+    max_new_tokens: int
+    eos_id: tp.Optional[int] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    admit_order: int
+    pages: tp.List[int] = dataclasses.field(default_factory=list)
+    length: int = 0  # tokens in the paged cache
+    prompt_pos: int = 0  # prompt tokens prefilled so far
+    generated: tp.List[int] = dataclasses.field(default_factory=list)
+    token_times: tp.List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prompt_pos < len(self.request.prompt)
+
+    @property
+    def remaining(self) -> int:
+        return self.request.max_new_tokens - len(self.generated)
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+    uid: int
+    tokens: np.ndarray  # prompt + generated
+    token_times: tp.List[float]  # wall-clock completion time per new token
+
+
+class ServeEngine:
+    """Host-side continuous-batching scheduler (module docstring)."""
+
+    def __init__(
+        self,
+        config: GPTConfig,
+        params: GPTParams,
+        *,
+        max_slots: int = 4,
+        num_pages: tp.Optional[int] = None,
+        page_size: int = 8,
+        prefill_chunk: int = 16,
+        decode_chunk: int = 8,
+        temperature: float = 0.0,
+        top_k: tp.Optional[int] = None,
+        top_p: tp.Optional[float] = None,
+        seed: int = 0,
+        cache_dtype=jnp.bfloat16,
+        attn_impl: str = "auto",
+    ):
+        assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
+        self.config = config
+        self.params = params
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.prefill_chunk = prefill_chunk
+        self.decode_chunk = decode_chunk
+        self.temperature = temperature
+        self.top_k, self.top_p = top_k, top_p
+        self.attn_impl = attn_impl
+        self.max_pages_per_slot = -(-config.block_size // page_size)
+        if num_pages is None:
+            # Default: half of what dedicated full-length caches would take
+            # (+ the sink) — the continuous-batching bet that Σ used-lengths
+            # stays well under n_slots * block_size.
+            num_pages = 1 + max_slots * self.max_pages_per_slot // 2
+        self.allocator = PageAllocator(num_pages)
+        self.cache = PagedKVCache.init(
+            config, num_pages=num_pages, page_size=page_size, dtype=cache_dtype
+        )
+        self.slots: tp.List[tp.Optional[_Slot]] = [None] * max_slots
+        self.queue: tp.List[Request] = []
+        self.finished: tp.Dict[int, FinishedRequest] = {}
+        self._key = jax.random.PRNGKey(seed)
+        self._uid = 0
+        self._admitted = 0
+
+    # -- public surface ------------------------------------------------
+
+    def submit(
+        self,
+        prompt: tp.Sequence[int],
+        max_new_tokens: int,
+        eos_id: tp.Optional[int] = None,
+    ) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        S = self.config.block_size
+        if len(prompt) + max_new_tokens > S:
+            # The paged pool is sized to the trained context; the windowed
+            # overflow scheme of engine.generate has no incremental cache to
+            # page. Reject instead of silently truncating.
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds block_size ({S})"
+            )
+        need = -(-(len(prompt) + max_new_tokens) // self.page_size)
+        if need > self.allocator.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.allocator.num_pages - 1} allocatable"
+            )
+        uid = self._uid
+        self._uid += 1
+        self.queue.append(Request(uid, prompt, max_new_tokens, eos_id))
+        return uid
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)
+
+    def run(self) -> tp.Dict[int, FinishedRequest]:
+        """Drive step() until everything submitted so far has finished."""
+        while not self.idle:
+            self.step()
+        return self.finished
+
+    def cache_hbm_bytes(self) -> int:
+        return self.cache.k.nbytes + self.cache.v.nbytes
+
+    # -- scheduling round ----------------------------------------------
+
+    def step(self) -> None:
+        """One round: admit -> one prefill chunk -> one decode chunk."""
+        self._admit()
+        self._prefill_round()
+        self._decode_round()
+
+    def _admit(self) -> None:
+        for i, s in enumerate(self.slots):
+            if s is None and self.queue:
+                req = self.queue.pop(0)
+                self.slots[i] = _Slot(req, self._admitted)
+                self._admitted += 1
+
+    def _ensure_pages(self, slot: _Slot, upto_tokens: int) -> bool:
+        """Grow slot's page list to cover positions [0, upto_tokens);
+        True on success. On pool exhaustion, evicts younger slots (youngest
+        first) and retries; False only if slot itself is the youngest left."""
+        need = -(-upto_tokens // self.page_size) - len(slot.pages)
+        while need > 0:
+            got = self.allocator.alloc(need)
+            if got is not None:
+                slot.pages.extend(got)
+                return True
+            victim = max(
+                (
+                    s
+                    for s in self.slots
+                    if s is not None and s.admit_order > slot.admit_order
+                ),
+                key=lambda s: s.admit_order,
+                default=None,
+            )
+            if victim is None:
+                return False
+            self._evict(victim)
+        return True
+
+    def _evict(self, victim: _Slot) -> None:
+        """Recompute-style preemption: fold generated tokens into the
+        prompt, free the pages, and re-queue at the FRONT so the request
+        resumes (by re-prefilling) as soon as the pool breathes."""
+        i = self.slots.index(victim)
+        req = victim.request
+        new_prompt = np.concatenate(
+            [req.prompt, np.asarray(victim.generated, np.int32)]
+        )
+        self.queue.insert(
+            0,
+            Request(
+                req.uid,
+                new_prompt,
+                req.max_new_tokens - len(victim.generated),
+                req.eos_id,
+            ),
+        )
+        self.allocator.free(victim.pages)
+        self.slots[i] = None
+
+    def _page_table(self, n_pages: tp.Optional[int] = None) -> np.ndarray:
+        table = np.zeros((self.max_slots, n_pages or self.max_pages_per_slot), np.int32)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                pages = s.pages[: table.shape[1]]
+                table[i, : len(pages)] = pages
+        return table
+
+    def _page_bucket(self, max_tokens: int) -> int:
+        """Smallest power-of-two page count covering `max_tokens` positions.
+
+        The serve step's attention (and its CPU gather fallback) is
+        O(table_width x page_size) per slot; slicing the table to a bucket
+        makes it O(longest-active-request) instead of O(block_size) — the
+        used-length attention lever of the ISSUE — while the pow2 bucketing
+        keeps the compile set logarithmic, not per-length."""
+        need = -(-max_tokens // self.page_size)
+        b = 1
+        while b < need:
+            b *= 2
+        return min(b, self.max_pages_per_slot)
+
+    def _prefill_round(self) -> None:
+        """Advance every mid-prompt slot by one (padded) chunk.
+
+        One chunk per slot per round bounds how long any running decode
+        stalls (a 30k prompt can't monopolize the device), while letting
+        freshly admitted slots reach the decode batch in parallel — an
+        empty decode slot is pure lost throughput."""
+        for slot_i, slot in enumerate(self.slots):
+            if slot is not None and slot.prefilling:
+                self._prefill_one(slot_i, slot)
+
+    def _prefill_one(self, slot_i: int, slot: _Slot) -> None:
+        prompt = slot.request.prompt
+        n_valid = min(self.prefill_chunk, len(prompt) - slot.prompt_pos)
+        if not self._ensure_pages(slot, slot.prompt_pos + n_valid):
+            return  # pool fully ours and still short — wait for finishes
+        if self.slots[slot_i] is not slot:  # evicted ourselves? (impossible)
+            return
+        chunk = np.zeros((1, self.prefill_chunk), np.int32)
+        chunk[0, :n_valid] = prompt[slot.prompt_pos : slot.prompt_pos + n_valid]
+        bucket = self._page_bucket(slot.prompt_pos + n_valid)
+        row = jnp.asarray(self._page_table(bucket)[slot_i : slot_i + 1])
+        logits, self.cache = _serve_prefill_chunk(
+            self.config,
+            self.params,
+            jnp.asarray(chunk),
+            jnp.asarray(slot.prompt_pos, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32),
+            self.cache,
+            row,
+        )
+        slot.prompt_pos += n_valid
+        slot.length = slot.prompt_pos
+        if not slot.prefilling:
+            # Prompt complete: sample the first generated token from the
+            # last valid prompt position's logits (host-side; greedy argmax
+            # matches engine.generate's sample_logits(temperature=0) exactly).
+            last = np.asarray(logits)[0, n_valid - 1]
+            if self.temperature == 0.0:
+                tok = int(np.argmax(last.astype(np.float32)))
+            else:
+                self._key, k = jax.random.split(self._key)
+                tok = int(
+                    sample_logits(
+                        jnp.asarray(last)[None],
+                        k,
+                        self.temperature,
+                        self.top_k,
+                        self.top_p,
+                    )[0]
+                )
+            self._append_token(slot_i, slot, tok, time.perf_counter())
+
+    def _decode_round(self) -> None:
+        active_idx = [
+            i
+            for i, s in enumerate(self.slots)
+            if s is not None and not s.prefilling and s.remaining > 0
+        ]
+        if not active_idx:
+            return
+        S = self.config.block_size
+        budget = min(
+            self.decode_chunk,
+            min(self.slots[i].remaining for i in active_idx),
+            min(S - self.slots[i].length for i in active_idx),
+        )
+        n = 1 << (budget.bit_length() - 1)  # largest power of two <= budget
+        for i in list(active_idx):
+            slot = self.slots[i]
+            if not self._ensure_pages(slot, slot.length + n):
+                active_idx.remove(i)  # shouldn't happen (submit() bound)
+        active_idx = [i for i in active_idx if self.slots[i] is not None]
+        if not active_idx:
+            return
+
+        token = np.zeros((self.max_slots,), np.int32)
+        lengths = np.zeros((self.max_slots,), np.int32)
+        active = np.zeros((self.max_slots,), bool)
+        for i in active_idx:
+            s = self.slots[i]
+            token[i] = s.generated[-1] if s.generated else s.request.prompt[-1]
+            lengths[i] = s.length
+            active[i] = True
+        if self.temperature == 0.0:
+            key = None
+        else:
+            self._key, key = jax.random.split(self._key)
+        bucket = self._page_bucket(
+            max(self.slots[i].length for i in active_idx) + n
+        )
+        self.cache, toks = _serve_decode_chunk(
+            self.config,
+            self.params,
+            jnp.asarray(token),
+            self.cache,
+            jnp.asarray(self._page_table(bucket)),
+            jnp.asarray(lengths),
+            jnp.asarray(active),
+            n,
+            self.temperature,
+            self.top_k,
+            self.top_p,
+            self.attn_impl,
+            key,
+        )
+        toks = np.asarray(toks)  # (n, B) — forces the dispatch
+        t_done = time.perf_counter()
+        for i in active_idx:
+            slot = self.slots[i]
+            if slot is None:
+                continue
+            for j in range(n):
+                slot.length += 1
+                if self._append_token(i, slot, int(toks[j, i]), t_done):
+                    break  # finished (max_new or EOS); rest of chunk discarded
+
+    def _append_token(self, slot_i: int, slot: _Slot, tok: int, t: float) -> bool:
+        """Record one generated token; returns True if the request finished
+        (and the slot was freed)."""
+        slot.generated.append(tok)
+        slot.token_times.append(t)
+        req = slot.request
+        hit_eos = req.eos_id is not None and tok == req.eos_id
+        if hit_eos or len(slot.generated) >= req.max_new_tokens:
+            self.finished[req.uid] = FinishedRequest(
+                uid=req.uid,
+                tokens=np.concatenate(
+                    [req.prompt, np.asarray(slot.generated, np.int32)]
+                ),
+                token_times=slot.token_times,
+            )
+            self.allocator.free(slot.pages)
+            self.slots[slot_i] = None
+            return True
+        return False

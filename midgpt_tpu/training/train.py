@@ -165,15 +165,27 @@ def make_train_step(
             return _sm_loss(params_c, x, y, key)
 
     else:
+        # Router load-balance pressure (config.moe_aux_coef): CE +
+        # coef * aux. Gated at trace time — with the default coef of 0.0
+        # the aux term is never even requested, so this path's compiled
+        # program is byte-identical to the pre-knob loss (zero-impact pin
+        # in tests/test_moe.py).
+        use_moe_aux = (
+            config.moe_aux_coef != 0.0 and model_cfg.n_experts > 0
+        )
 
         def loss_fn(params_c: GPTParams, x: Array, y: Array, key) -> Array:
             h = GPT.hidden(
-                model_cfg, params_c, x, key=key, inference=False, attn_fn=attn_fn
+                model_cfg, params_c, x, key=key, inference=False, attn_fn=attn_fn,
+                return_moe_aux=use_moe_aux,
             )
-            return fused_linear_cross_entropy(
+            if use_moe_aux:
+                h, aux = h
+            ce = fused_linear_cross_entropy(
                 h, params_c.lm_head, y, config.loss_chunk_tokens,
                 config.loss_remat_chunks,
             )
+            return ce + config.moe_aux_coef * aux if use_moe_aux else ce
 
     def cast_compute(params: GPTParams) -> GPTParams:
         return jax.tree.map(

@@ -1,0 +1,79 @@
+"""Version-compat shims for the jax APIs this repo uses.
+
+The repo targets current jax, but the container images it runs in pin older
+releases (observed: 0.4.37, where `jax.shard_map` is still
+`jax.experimental.shard_map.shard_map`, the CPU device count is an XLA flag
+rather than a config option, and the Mosaic params class carries a TPU
+prefix). Everything version-dependent resolves here, once, so call sites
+stay on the modern spelling.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+        """Modern keyword surface on the experimental implementation:
+        `check_vma` was `check_rep`, and `axis_names` (the MANUAL axes) is
+        the complement of the old `auto` frozenset. check_rep is forced off
+        — the old replication checker has no rule for the `name` primitive
+        (jax.ad_checkpoint.checkpoint_name, used by the remat policies), and
+        it is a diagnostics-only pass."""
+        kw.pop("check_vma", None)
+        kw["check_rep"] = False
+        if "axis_names" in kw:
+            auto = frozenset(mesh.axis_names) - frozenset(kw.pop("axis_names"))
+            if auto and jax.default_backend() == "cpu":
+                # Observed on 0.4.37: lowering a partial-manual body on the
+                # CPU backend dies in an XLA CHECK (the AllReducePromotion
+                # family — the same pass the full-manual tp=1 path already
+                # sidesteps, parallel/pipeline.py auto_tp_shard_map_kwargs).
+                # A Python error keeps the test suite running; a CHECK
+                # abort would take the whole process with it.
+                raise NotImplementedError(
+                    "partial-manual shard_map (GSPMD 'auto' axes) aborts in "
+                    "XLA CPU on this jax build; tp>1 shard_map compositions "
+                    "need a TPU backend or a newer jax here"
+                )
+            kw["auto"] = auto
+        return _shard_map_experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+def axis_size(axis_name) -> int:
+    """`jax.lax.axis_size` (added ~0.5); older releases spell it as a psum
+    of the literal 1 over the axis (statically evaluated, no collective)."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Request `n` virtual CPU devices. MUST run before first backend use.
+
+    Modern jax has a config option; older jax only honors the XLA host
+    platform flag (the pre-config mechanism — same effect)."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        )
+
+
+def tpu_compiler_params(**kw):
+    """pltpu.CompilerParams across the TPUCompilerParams rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)

@@ -40,13 +40,27 @@ def while_body_names(txt: str) -> tp.Set[str]:
     return set(re.findall(r"body=%([\w.\-]+)", txt))
 
 
+# jax renamed the shard_map trace scopes: modern HLO metadata reads
+# `jvp()/shard_map/...`, older releases `jvp(jit(shmap_body))/...`. Every
+# structural pin matches through these helpers so the spelling difference
+# can't silently turn a pin vacuous.
+
+
+def in_shard_map_scope(line: str) -> bool:
+    """Is this HLO instruction annotated as coming from a shard_map body?"""
+    return "/shard_map/" in line or "shmap_body)" in line
+
+
+def is_forward_shmap_line(line: str) -> bool:
+    """Forward (jvp, not transpose(jvp)) shard_map provenance."""
+    return in_shard_map_scope(line) and "jvp(" in line and "transpose(" not in line
+
+
 def is_forward_body(lines: tp.Sequence[str]) -> bool:
     """Forward (jvp) vs backward (transpose(jvp)) scan-body classification,
     shared by tests/test_shard_map_fsdp.py and tools/check_overlap_tpu.py so
     the two overlap pins can't drift on what they call 'forward'."""
-    return any(
-        "jvp()/shard_map/while" in l and "transpose(" not in l for l in lines
-    )
+    return any(is_forward_shmap_line(l) and "while" in l for l in lines)
 
 
 def lower_abstract_train_step(config, mesh=None):

@@ -27,6 +27,20 @@ def main() -> None:
     parser.add_argument("--top_k", type=int, default=None)
     parser.add_argument("--top_p", type=float, default=None, help="nucleus sampling mass")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--engine",
+        choices=("batch", "continuous"),
+        default="batch",
+        help="'batch': one fixed (num_samples, T) batch through the KV-cache "
+        "loop; 'continuous': the paged continuous-batching server "
+        "(sampling/serve.py) — each sample is an independent request, so "
+        "mixed --max_new_tokens finish independently instead of padding to "
+        "the longest (docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--max_slots", type=int, default=4,
+        help="continuous engine: concurrent decode slots",
+    )
     args = parser.parse_args()
 
     import jax
@@ -108,16 +122,35 @@ def main() -> None:
     start_ids = encode(start if start != "" else "\n")
     prompt = np.tile(np.asarray(start_ids, np.int32), (args.num_samples, 1))
 
-    out = generate(
-        model_cfg,
-        params,
-        prompt,
-        args.max_new_tokens,
-        temperature=args.temperature,
-        top_k=args.top_k,
-        top_p=args.top_p,
-        key=jax.random.PRNGKey(args.seed),
-    )
+    if args.engine == "continuous":
+        from midgpt_tpu.sampling.serve import ServeEngine
+
+        eng = ServeEngine(
+            model_cfg,
+            params,
+            max_slots=args.max_slots,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            seed=args.seed,
+        )
+        uids = [
+            eng.submit(prompt[i], args.max_new_tokens)
+            for i in range(args.num_samples)
+        ]
+        finished = eng.run()
+        out = [finished[u].tokens for u in uids]
+    else:
+        out = generate(
+            model_cfg,
+            params,
+            prompt,
+            args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            key=jax.random.PRNGKey(args.seed),
+        )
     for i in range(args.num_samples):
         print(decode(np.asarray(out[i]).tolist()))
         print("---------------")

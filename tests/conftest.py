@@ -7,8 +7,10 @@ platform selection must go through the config API before first backend use.
 
 import jax
 
+from midgpt_tpu.utils.compat import set_cpu_device_count
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_cpu_device_count(8)
 jax.config.update("jax_threefry_partitionable", True)
 # This JAX build defaults matmuls to reduced (bf16-style) precision even on
 # CPU; force full f32 so numerical parity tests are meaningful.

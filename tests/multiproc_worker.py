@@ -38,7 +38,9 @@ mode = sys.argv[5] if len(sys.argv) > 5 else "train"
 rundir = sys.argv[6] if len(sys.argv) > 6 else ""
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from midgpt_tpu.utils.compat import set_cpu_device_count
+
+set_cpu_device_count(2)
 jax.distributed.initialize(
     coordinator_address=coordinator, num_processes=n_proc, process_id=proc_id
 )

@@ -39,8 +39,13 @@ def test_golden_loss_trajectory(tmp_path):
         err_msg=(
             "training trajectory drifted from the golden fixture "
             f"(generated on {fixture['versions']}) — a numerics "
-            "regression in init/optimizer/loss, or a software-stack change "
-            "(jax math, numpy Generator streams, optax internals); "
+            "regression in init/optimizer/loss, a software-stack change "
+            "(jax math, numpy Generator streams, optax internals), OR a "
+            "different host platform/CPU than the fixture's: XLA:CPU "
+            "vectorizes reductions per ISA, so the same program can give "
+            "ulp-different f32 sums on another machine — compare the "
+            "fixture's platform/machine/processor fields against this "
+            "host before suspecting the code; "
             "see tests/test_golden_loss.py docstring"
         ),
     )

@@ -13,9 +13,21 @@ import socket
 import subprocess
 import sys
 
+import jax
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Multi-process SPMD on the CPU backend needs the cross-process CPU
+# collectives that landed after this container's jax; on older builds the
+# worker dies with "Multiprocess computations aren't implemented on the CPU
+# backend" — an environment gap, not a repo regression, so skip cleanly.
+_JAX = tuple(int(x) for x in jax.__version__.split(".")[:2])
+requires_multiproc_cpu = pytest.mark.skipif(
+    _JAX < (0, 5),
+    reason=f"multi-process CPU collectives unsupported on jax {jax.__version__}",
+)
 
 
 def _free_port() -> int:
@@ -24,6 +36,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@requires_multiproc_cpu
 def test_two_process_train_step(tmp_path):
     rng = np.random.default_rng(0)
     for split in ("train", "val"):
@@ -93,6 +106,7 @@ def _run_workers(tmp_path, mode, rundir=""):
     return vals
 
 
+@requires_multiproc_cpu
 def test_two_process_checkpoint_roundtrip(tmp_path):
     """Sharded checkpoint round-trip across process restarts: 2 processes
     train 2 steps and save (each writing its own shards), a FRESH pair of

@@ -80,6 +80,27 @@ def test_split_style_is_permutation_conjugate():
     np.testing.assert_allclose(np.asarray(s_split), np.asarray(s_ref), atol=1e-5)
 
 
+def test_rope_per_slot_positions():
+    """apply_rope_positions ((B, T) per-token absolute positions — the
+    continuous-batching decode path, where B slots sit at B different
+    write positions) must be bit-identical to apply_rope_bthc run per-row
+    at that row's position, in both rotation styles."""
+    from midgpt_tpu.ops.rope import apply_rope_bthc, apply_rope_positions
+
+    key = jax.random.PRNGKey(4)
+    B, T, H, C = 3, 2, 2, 16
+    x = jax.random.normal(key, (B, T, H, C))
+    sin, cos = rope_table(C, 64)
+    positions = jnp.asarray([[0, 1], [17, 18], [40, 41]])
+    for style in ("interleaved", "split"):
+        got = apply_rope_positions(x, sin, cos, positions, style=style)
+        for b in range(B):
+            want = apply_rope_bthc(
+                x[b : b + 1], sin, cos, positions=positions[b], style=style
+            )
+            np.testing.assert_array_equal(np.asarray(got[b]), np.asarray(want[0]))
+
+
 def test_rope_positions_gather():
     """Explicit positions must equal the contiguous-prefix default."""
     key = jax.random.PRNGKey(2)

@@ -194,6 +194,105 @@ def test_top_p_nucleus():
         assert int(a[0]) == int(b[0])
 
 
+def test_decode_layer_scan_matches_unrolled(params):
+    """GPTConfig.decode_layer_scan swaps the decode layer loop's lowering
+    (Python-unrolled DUS chain vs rolled lax.scan — compile-time/copy
+    trade-off documented on the config field); both must produce the same
+    logits and cache."""
+    import dataclasses
+
+    cfg_scan = dataclasses.replace(CFG, decode_layer_scan=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (2, 9), 0, CFG.vocab_size)
+    extra = jax.random.randint(jax.random.PRNGKey(12), (2, 3), 0, CFG.vocab_size)
+
+    caches = {}
+    for name, cfg in (("unroll", CFG), ("scan", cfg_scan)):
+        cache = KVCache.init(cfg, 2, dtype=jnp.float32)
+        _, cache = GPT.prefill(cfg, params, tokens, cache)
+        logits = []
+        for i in range(3):
+            l, cache = GPT.decode_step(cfg, params, extra[:, i], cache)
+            logits.append(l)
+        caches[name] = (jnp.stack(logits), cache)
+    np.testing.assert_allclose(
+        np.asarray(caches["scan"][0]), np.asarray(caches["unroll"][0]),
+        atol=1e-6, rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(caches["scan"][1].k), np.asarray(caches["unroll"][1].k),
+        atol=1e-6,
+    )
+
+
+def test_paged_decode_matches_contiguous_token_for_token(params):
+    """ISSUE acceptance pin: greedy decode through the paged cache + page
+    table samples the SAME tokens as the contiguous-cache engine, for a
+    fixed seed, across chunked prefill and per-slot positions."""
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (1, 19), 0, CFG.vocab_size)
+    ref = generate(CFG, params, prompt, 10, temperature=0.0)
+
+    eng = ServeEngine(
+        CFG, params, max_slots=2, page_size=8, prefill_chunk=8,
+        decode_chunk=4, temperature=0.0, cache_dtype=jnp.float32,
+    )
+    uid = eng.submit(np.asarray(prompt[0]), 10)
+    out = eng.run()[uid].tokens
+    np.testing.assert_array_equal(out, np.asarray(ref[0]))
+
+
+def test_serve_decode_chunk_has_no_in_loop_cache_copies():
+    """The r5 structural pin, extended to the PAGED serve step (ISSUE
+    acceptance): inside the compiled serve chunk's decode loop, no
+    pool-sized copy may appear — the per-slot column writes must lower to
+    in-place scatters aliasing through the loop carry. One-time entry
+    copies outside the loop are allowed (same allowance as the contiguous
+    pin below)."""
+    import re
+
+    from midgpt_tpu.models.gpt import PagedKVCache
+    from midgpt_tpu.sampling import serve
+    from midgpt_tpu.utils.hlo import hlo_computations, while_body_names
+
+    cfg = GPTConfig(
+        block_size=256, vocab_size=96, n_layer=4, n_head=2, n_embd=64
+    )
+    B, ps, n_pages = 4, 8, 40
+    L, H, C = cfg.n_layer, cfg.n_head, cfg.head_dim
+    max_pages = cfg.block_size // ps
+    abstract = jax.eval_shape(lambda k: GPT.init(cfg, k), jax.random.PRNGKey(0))
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, jnp.bfloat16), abstract
+    )
+    cache = jax.eval_shape(
+        lambda: PagedKVCache.init(cfg, num_pages=n_pages, page_size=ps)
+    )
+    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    pt = jax.ShapeDtypeStruct((B, max_pages), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    act = jax.ShapeDtypeStruct((B,), jnp.bool_)
+    fn = jax.jit(
+        lambda p, t, c, table, lens, a: serve._serve_decode_chunk(
+            cfg, p, t, c, table, lens, a, 8, 0.0, None, None, "gather", None
+        )
+    )
+    txt = fn.lower(abstract, tok, cache, pt, ln, act).compile().as_text()
+    bodies = while_body_names(txt)
+    shape = re.escape(f"bf16[{L},{H},{n_pages},{ps},{C}]")
+    offenders = [
+        (name, l)
+        for name, lines in hlo_computations(txt).items()
+        if name in bodies
+        for l in lines
+        if re.search(rf"= {shape}[^=]*copy\(", l)
+    ]
+    assert not offenders, (
+        "pool-sized copies inside the serve decode loop body — the paged KV "
+        f"cache no longer aliases through the carry: {offenders[:2]}"
+    )
+
+
 def test_decode_chunk_has_no_in_loop_cache_copies():
     """Structural pin of the r5 decode restructure: inside the chunked
     decode loop, NO full-cache-sized copy may appear — the per-token column

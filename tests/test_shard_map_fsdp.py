@@ -16,6 +16,18 @@ from midgpt_tpu.parallel.fsdp import constrain, fsdp_param_specs
 from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
 from midgpt_tpu.parallel.shard_map_fsdp import make_shard_map_loss
 
+import pytest
+# The tp>1 composition runs the shard_map body partial-manual (GSPMD 'auto'
+# axes); on this container's old jax the XLA CPU backend aborts in a CHECK
+# on that combination, so utils/compat.py refuses it up front — skip
+# cleanly here (runs on TPU backends / newer jax).
+_JAX = tuple(int(x) for x in jax.__version__.split(".")[:2])
+requires_partial_manual_cpu = pytest.mark.skipif(
+    _JAX < (0, 5) and jax.default_backend() == "cpu",
+    reason=f"partial-manual shard_map aborts XLA CPU on jax {jax.__version__}",
+)
+
+
 CHUNK = 1 << 30  # no loss chunking: keeps the comparison single-variable
 
 
@@ -72,8 +84,10 @@ def test_grads_sharded_like_params():
     grads = jax.jit(
         jax.grad(lambda p, x, y: sm_loss(p, x, y, None))
     )(params, xg, yg)
-    flat_g, _ = jax.tree.flatten_with_path(grads)
-    flat_p, _ = jax.tree.flatten_with_path(params)
+    # tree_util spelling: jax.tree.flatten_with_path arrived later than
+    # this container's jax; the tree_util alias exists in both.
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(grads)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(params)
     for (path, g), (_, p) in zip(flat_g, flat_p):
         assert g.sharding == p.sharding, f"{path}: {g.sharding} != {p.sharding}"
 
@@ -121,7 +135,8 @@ def test_train_step_e2e_shard_map():
 
 from midgpt_tpu.utils.hlo import (  # noqa: E402
     hlo_computations as _hlo_computations,
-    is_forward_body,
+    in_shard_map_scope,
+    is_forward_shmap_line,
 )
 
 
@@ -189,15 +204,19 @@ def test_zero3_gathers_schedulable_ahead_of_compute():
     txt = lower_abstract_train_step(config).compile().as_text()
 
     comps = _hlo_computations(txt)
-    # Scan bodies containing weight gathers: the forward body (jvp) and the
-    # backward body (transpose(jvp), ZeRO-3 re-gather under remat).
+    # Computations containing shard_map weight gathers next to compute: the
+    # forward layer-scan body (jvp) and the backward one (transpose(jvp),
+    # ZeRO-3 re-gather under remat). XLA may fully unroll the short forward
+    # scan into its caller on some backends/versions — the gathers keep
+    # their shard_map provenance metadata either way, so match on that
+    # rather than on living inside a while body.
     bodies = {
         name: lines
         for name, lines in comps.items()
-        if any(" all-gather(" in l and "shard_map/while" in l for l in lines)
+        if any(" all-gather(" in l and in_shard_map_scope(l) for l in lines)
         and any(" dot(" in l for l in lines)
     }
-    assert bodies, "no scan body with all-gathers found — did lowering change?"
+    assert bodies, "no computation with shard_map all-gathers found — did lowering change?"
 
     fwd_counts = []
     for name, lines in bodies.items():
@@ -210,8 +229,13 @@ def test_zero3_gathers_schedulable_ahead_of_compute():
             deps = [r for r in re.findall(r"%([\w.\-]+)", line) if r != iname]
             defs[iname] = (line, deps)
         gathers = [n for n, (l, _) in defs.items() if " all-gather(" in l]
-        if is_forward_body([l for l, _ in defs.values()]):
-            fwd_counts.append(len(gathers))
+        n_fwd = sum(
+            1
+            for n, (l, _) in defs.items()
+            if " all-gather(" in l and is_forward_shmap_line(l)
+        )
+        if n_fwd:
+            fwd_counts.append(n_fwd)
         for g in gathers:
             seen, stack = set(), list(defs[g][1])
             while stack:
@@ -235,6 +259,7 @@ def test_zero3_gathers_schedulable_ahead_of_compute():
     )
 
 
+@requires_partial_manual_cpu
 def test_train_step_shard_map_tp_matches_gspmd():
     """r5: the explicit ZeRO-3 body composes with Megatron tp — 'tp' rides
     a GSPMD auto axis inside the shard_map (parallel/shard_map_fsdp.py)

@@ -1,0 +1,184 @@
+"""Continuous-batching serving bench: one JSON line (driver contract).
+
+Runs a seeded synthetic mixed-length request trace twice through each mode —
+the first pass warms every jit shape (compile time is not a serving-rate
+claim), the second is timed:
+
+  * continuous — sampling/serve.py ServeEngine: paged KV cache, chunked
+    prefill interleaved with batched decode, admission the moment a slot
+    frees.
+  * sequential — the fixed-batch engine.generate, one request at a time
+    (what the pre-serving repo could do for a stream of arriving requests).
+
+Reported: aggregate tokens/sec for both modes (the ISSUE acceptance is
+continuous > sequential), p50/p99 per-token latency and mean TTFT for the
+continuous run (chunk-granular: a decode chunk's n tokens each count
+gap/n), and the HBM high-water of each mode's cache (analytic bytes — the
+paged pool vs the per-request contiguous cache — plus the device allocator
+peak when the backend exposes one; per CLAUDE.md, wall-clock through the
+TPU tunnel is untrustworthy below many iterations, so treat the CPU-mesh
+numbers as scheduling-structure signal, not kernel-speed signal).
+
+    python tools/bench_serve.py [--n-requests 12] [--max-slots 4] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-requests", type=int, default=12)
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--decode-chunk", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=256)
+    ap.add_argument("--vocab-size", type=int, default=512)
+    ap.add_argument("--n-layer", type=int, default=4)
+    ap.add_argument("--n-head", type=int, default=4)
+    ap.add_argument("--n-embd", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force CPU with this many virtual devices (0 = native backend)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu_devices:
+        from midgpt_tpu.utils.compat import set_cpu_device_count
+
+        jax.config.update("jax_platforms", "cpu")
+        set_cpu_device_count(args.cpu_devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from midgpt_tpu.models.gpt import GPT, GPTConfig, KVCache
+    from midgpt_tpu.sampling.engine import generate
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = GPTConfig(
+        block_size=args.block_size,
+        vocab_size=args.vocab_size,
+        n_layer=args.n_layer,
+        n_head=args.n_head,
+        n_embd=args.n_embd,
+    )
+    params = GPT.init(cfg, jax.random.PRNGKey(args.seed))
+    if on_tpu:
+        params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    cache_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    # Mixed-length trace: short chat-y prompts to near-context documents.
+    rng = np.random.default_rng(args.seed)
+    S = cfg.block_size
+    trace = []
+    for _ in range(args.n_requests):
+        t0 = int(rng.integers(4, max(5, S // 2)))
+        m = int(rng.integers(8, max(9, min(64, S - t0))))
+        trace.append((rng.integers(0, cfg.vocab_size, t0, dtype=np.int64), m))
+    total_new = sum(m for _, m in trace)
+
+    def run_continuous():
+        eng = ServeEngine(
+            cfg,
+            params,
+            max_slots=args.max_slots,
+            page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk,
+            decode_chunk=args.decode_chunk,
+            temperature=0.0,
+            cache_dtype=cache_dtype,
+        )
+        for prompt, m in trace:
+            eng.submit(prompt, m)
+        t0 = time.perf_counter()
+        done = eng.run()
+        # Force everything to host (np conversion happened per chunk already).
+        dt = time.perf_counter() - t0
+        return eng, done, dt, t0
+
+    def run_sequential():
+        t0 = time.perf_counter()
+        outs = [
+            generate(cfg, params, jnp.asarray(p, jnp.int32)[None], m, temperature=0.0)
+            for p, m in trace
+        ]
+        outs = [np.asarray(o) for o in outs]  # force
+        return time.perf_counter() - t0
+
+    run_continuous()  # warm every prefill/decode-chunk shape
+    eng, done, dt_cont, t_start = run_continuous()
+    run_sequential()  # warm per-prompt-length prefills + decode chunks
+    dt_seq = run_sequential()
+
+    # Per-token latency at chunk granularity: a chunk of n tokens landing
+    # gap seconds after the previous event costs gap/n per token. TTFT is
+    # the first token's time after engine start.
+    lat, ttft = [], []
+    for fr in done.values():
+        ts = np.asarray(fr.token_times)
+        ttft.append(ts[0] - t_start)
+        edges = np.flatnonzero(np.diff(ts) > 0) + 1
+        groups = np.split(ts, edges)
+        prev = ts[0]
+        for g in groups[1:]:
+            lat.extend([(g[0] - prev) / len(g)] * len(g))
+            prev = g[0]
+    lat = np.asarray(lat) if lat else np.zeros(1)
+
+    # HBM high-water of the caches (analytic; allocator peak if exposed).
+    paged_bytes = eng.cache_hbm_bytes()
+    itemsize = jnp.dtype(cache_dtype).itemsize
+    contiguous_bytes = (
+        2 * cfg.n_layer * cfg.n_head * S * cfg.head_dim * itemsize
+    )  # per-request KVCache the sequential engine allocates
+    try:
+        peak = jax.local_devices()[0].memory_stats().get("peak_bytes_in_use")
+    except Exception:
+        peak = None
+
+    print(
+        json.dumps(
+            {
+                "bench": "serve",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "max_slots": args.max_slots,
+                "page_size": args.page_size,
+                "num_pages": eng.allocator.num_pages,
+                "prefill_chunk": args.prefill_chunk,
+                "decode_chunk": args.decode_chunk,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": S,
+                },
+                "continuous_tok_s": round(total_new / dt_cont, 2),
+                "sequential_tok_s": round(total_new / dt_seq, 2),
+                "speedup": round(dt_seq / dt_cont, 3),
+                "p50_token_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p99_token_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                "ttft_ms_mean": round(float(np.mean(ttft)) * 1e3, 3),
+                "hbm_paged_cache_bytes": int(paged_bytes),
+                "hbm_sequential_cache_bytes": int(contiguous_bytes),
+                "device_peak_bytes_in_use": peak,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

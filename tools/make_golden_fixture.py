@@ -33,17 +33,25 @@ def main() -> int:
         import numpy
         import optax
 
+        import platform
+
         json.dump(
             {
                 "spec": golden_runner.GOLDEN_SPEC,
                 # The trajectory depends on all three stacks: jax (compiled
                 # math + threefry), numpy (Generator method streams are NOT
                 # guaranteed stable across feature releases, NEP 19), optax
-                # (chain internals).
+                # (chain internals) — AND on the host platform: XLA:CPU
+                # emits different vector code per ISA (AVX-512 vs AVX2 vs
+                # aarch64 NEON), so f32 reduction shapes can differ across
+                # machines even on identical software (ADVICE r5).
                 "versions": {
                     "jax": jax.__version__,
                     "numpy": numpy.__version__,
                     "optax": optax.__version__,
+                    "platform": platform.platform(),
+                    "machine": platform.machine(),
+                    "processor": platform.processor() or "unknown",
                 },
                 "losses": losses,
             },
