@@ -409,8 +409,20 @@ class ServeEngine:
         n = 1 << (budget.bit_length() - 1)  # largest power of two <= budget
         for i in list(active_idx):
             slot = self.slots[i]
+            if slot is None:
+                # An older slot's _ensure_pages earlier in this loop evicted
+                # this one (eviction picks the youngest slot, which can sit
+                # at any index). It is already re-queued; skip it.
+                active_idx.remove(i)
+                continue
             if not self._ensure_pages(slot, slot.length + n):
-                active_idx.remove(i)  # shouldn't happen (submit() bound)
+                # Reachable: the pool is held by slots at least as old as
+                # this one, so there is no younger victim to evict. Defer
+                # the slot to a later round; it resumes once older requests
+                # finish and free pages.
+                active_idx.remove(i)
+        # A slot processed earlier in the loop can still be evicted by a
+        # later, older slot's growth — drop any that went None.
         active_idx = [i for i in active_idx if self.slots[i] is not None]
         if not active_idx:
             return

@@ -88,6 +88,32 @@ def test_serve_parity_under_eviction(params):
         )
 
 
+def test_serve_decode_time_eviction_of_active_slot(params):
+    """Regression: mid-decode page growth for an OLDER slot evicts the
+    youngest slot, which can sit at a LATER index of the same decode
+    round's loop. The round must skip the freed slot (it re-queues and
+    re-prefills) rather than dereference None — and parity must survive
+    the preemption. Short prompts make eviction fire during decode, not
+    prefill (test_serve_parity_under_eviction covers the prefill case)."""
+    rng = np.random.default_rng(3)
+    trace = [
+        (rng.integers(0, CFG.vocab_size, 8).astype(np.int32), 40)
+        for _ in range(3)
+    ]
+    eng = ServeEngine(
+        CFG, params, max_slots=3, page_size=8, num_pages=10,
+        temperature=0.0, cache_dtype=jnp.float32,
+    )
+    uids = [eng.submit(p, m) for p, m in trace]
+    done = eng.run()
+    assert set(done) == set(uids)
+    for (p, m), u in zip(trace, uids):
+        ref = generate(CFG, params, jnp.asarray(p)[None], m, temperature=0.0)
+        np.testing.assert_array_equal(
+            done[u].tokens, np.asarray(ref[0]), err_msg=f"request {u}"
+        )
+
+
 def test_serve_eos_frees_slot_early(params):
     """EOS finishes a request mid-chunk; its pages return to the pool and
     its tokens stop at the EOS."""
