@@ -13,10 +13,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import jax
+
+if os.environ.get("MIDGPT_PLATFORM"):
+    # Same opt-in as launch.py: the axon TPU plugin ignores JAX_PLATFORMS,
+    # so off-TPU runs (tests/test_bench_contract.py validates the JSON
+    # contract on the CPU mesh) must select the platform via the config API
+    # before backend init.
+    jax.config.update("jax_platforms", os.environ["MIDGPT_PLATFORM"])
+    if os.environ.get("MIDGPT_CPU_DEVICES"):
+        from midgpt_tpu.utils.compat import set_cpu_device_count
+
+        set_cpu_device_count(int(os.environ["MIDGPT_CPU_DEVICES"]))
+
 import numpy as np
 
 BASELINE_MFU = 0.478  # reference 1.5B on v3-128 (BASELINE.md)
@@ -50,6 +63,10 @@ def main() -> int:
         "attention MXU utilization to probe the >=55%% MFU target",
     )
     parser.add_argument("--layers", type=int, default=None, help="override n_layer")
+    parser.add_argument("--vocab", type=int, default=None,
+                        help="override vocab_size (contract tests shrink the "
+                        "embedding to run the full bench path off-TPU; the "
+                        "default keeps the shape config's padded vocab)")
     parser.add_argument("--rope", type=str, default=None,
                         choices=["interleaved", "split"],
                         help="RoPE lowering override (default: the shape "
@@ -92,6 +109,7 @@ def main() -> int:
     model_cfg = dataclasses.replace(
         model_cfg,
         **shape_overrides,
+        **({"vocab_size": args.vocab} if args.vocab else {}),
         **({"block_size": args.seq} if args.seq else {}),
         attn_impl=attn,
         remat=args.remat != "off",

@@ -1,0 +1,42 @@
+"""graftcheck — JAX/TPU-aware static analysis for this repo.
+
+Two passes (docs/ANALYSIS.md is the rule catalog):
+
+  * **Pass 1 — AST lint** (`analysis.lint`, no JAX import): walks package
+    source and flags the compilation-behavior footguns that CLAUDE.md and
+    RESULTS.md record as hard-won gotchas — control flow in Pallas kernel
+    bodies, host syncs inside jitted scopes, untiled BlockSpec literals,
+    use-after-donate, wall-clock/np.random reachable from traced code, and
+    uncited parity claims. Rules GC001-GC006, suppressible inline with
+    `# graftcheck: disable=GCnnn — justification`.
+  * **Pass 2 — compiled-artifact audit** (`analysis.hlo_audit`, builds on
+    utils/hlo.py): executable pins over post-optimization HLO and the jit
+    compile cache — recompile counting, while-body collective census, fp32
+    master-param presence — so the scheduling/parity claims in SERVING.md
+    and SURVEY.md §7 are tested, not remembered.
+
+`analysis.bench_contract` is the shared checker for the one-JSON-line
+driver contract that bench.py / tools/bench_serve.py (and the graftcheck
+CLI's own --json mode) must honor.
+
+CLI: `python -m midgpt_tpu.analysis [paths...] [--json] [--audit]`
+(tools/graftcheck.py is a path-setup wrapper). Pass 1 never initializes a
+JAX backend, so the lint gate is safe to run on hosts where device init is
+slow or unavailable.
+"""
+
+from midgpt_tpu.analysis.lint import (
+    DEFAULT_LINT_ROOTS,
+    Finding,
+    RULES,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "DEFAULT_LINT_ROOTS",
+    "Finding",
+    "RULES",
+    "lint_paths",
+    "lint_source",
+]

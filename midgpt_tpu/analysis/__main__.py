@@ -1,0 +1,112 @@
+"""graftcheck CLI: `python -m midgpt_tpu.analysis [paths...] [options]`.
+
+Exit status: 0 when no active findings (and, with --audit, every audit
+passes); 1 otherwise. Default output is one `path:line:col: GCnnn message`
+line per finding; --json emits ONE JSON line (the bench.py driver
+convention — schema in analysis/bench_contract.py) so automated drivers
+can consume findings without scraping.
+
+Pass 1 (the lint) performs no JAX backend initialization; --audit opts into
+pass 2, which forces the CPU backend before first JAX use (the axon TPU
+plugin ignores JAX_PLATFORMS — CLAUDE.md) and compiles two tiny abstract
+programs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import typing as tp
+
+from midgpt_tpu.analysis.lint import DEFAULT_LINT_ROOTS, RULES, lint_paths
+
+
+def _default_paths() -> tp.List[str]:
+    """Resolve DEFAULT_LINT_ROOTS against the repo root (the parent of the
+    midgpt_tpu package), so the CLI works from any cwd."""
+    import midgpt_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(midgpt_tpu.__file__)))
+    return [p for p in (os.path.join(repo, r) for r in DEFAULT_LINT_ROOTS) if os.path.exists(p)]
+
+
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck", description="JAX/TPU-aware static analysis for midgpt_tpu"
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the package, tools/ and "
+        "the top-level entry points; tests/ is excluded — fixtures there "
+        "are deliberate violations)",
+    )
+    ap.add_argument("--json", action="store_true", help="one JSON line (driver contract)")
+    ap.add_argument(
+        "--rules",
+        type=str,
+        default=None,
+        help="comma-separated rule subset, e.g. GC001,GC003",
+    )
+    ap.add_argument(
+        "--audit",
+        action="store_true",
+        help="also run pass 2 (compiled-artifact audit; imports jax, CPU-only)",
+    )
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",")]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rule(s) {unknown}; known: {sorted(RULES)}")
+
+    paths = args.paths or _default_paths()
+    active, suppressed, n_files = lint_paths(paths, rules)
+
+    audit_report: tp.Optional[tp.Dict[str, tp.Any]] = None
+    audit_error: tp.Optional[str] = None
+    if args.audit:
+        # Force CPU before any backend touch: the axon TPU plugin overrides
+        # JAX_PLATFORMS, so env alone cannot keep the audit off the tunnel.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from midgpt_tpu.analysis.hlo_audit import run_audit
+
+        try:
+            audit_report = run_audit()
+        except AssertionError as e:
+            audit_error = str(e)
+
+    failed = bool(active) or audit_error is not None
+    if args.json:
+        out: tp.Dict[str, tp.Any] = {
+            "tool": "graftcheck",
+            "count": len(active),
+            "suppressed": len(suppressed),
+            "files_scanned": n_files,
+            "findings": [f.to_dict() for f in active],
+        }
+        if args.audit:
+            out["audit"] = audit_report if audit_error is None else {"error": audit_error}
+        print(json.dumps(out))
+    else:
+        for f in active:
+            print(f.format())
+        if audit_error is not None:
+            print(f"audit: FAILED — {audit_error}")
+        elif audit_report is not None:
+            print(f"audit: ok — {json.dumps(audit_report)}")
+        print(
+            f"graftcheck: {len(active)} finding(s), {len(suppressed)} suppressed, "
+            f"{n_files} file(s) scanned"
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

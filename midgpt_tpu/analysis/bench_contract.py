@@ -1,0 +1,155 @@
+"""Shared checker for the one-JSON-line driver contract.
+
+bench.py and tools/bench_serve.py each print exactly ONE line of JSON to
+stdout and the driver consumes it blind — a stray print, a NaN (json.dumps
+emits bare `NaN`, which is not JSON), or a silently renamed field breaks
+the pipeline with no test noticing. This module is the single place the
+contract is written down; tests/test_bench_contract.py runs the real bench
+entry points and validates their stdout through it, and the graftcheck CLI
+validates its own --json output the same way.
+
+Checkers return a list of problem strings (empty = conformant) rather than
+raising, so callers can aggregate.
+"""
+
+from __future__ import annotations
+
+import json
+import typing as tp
+
+Number = (int, float)
+
+
+def _reject_nonfinite(value: str) -> tp.NoReturn:
+    raise ValueError(f"non-finite JSON constant {value!r} (NaN/Infinity is not JSON)")
+
+
+def parse_single_json_line(stdout: str) -> tp.Tuple[tp.Optional[dict], tp.List[str]]:
+    """Enforce 'stdout is exactly one JSON object line'. Returns (record,
+    problems); record is None when parsing failed."""
+    problems: tp.List[str] = []
+    lines = [l for l in stdout.splitlines() if l.strip()]
+    if len(lines) != 1:
+        problems.append(f"expected exactly 1 non-empty stdout line, got {len(lines)}")
+        if not lines:
+            return None, problems
+    try:
+        rec = json.loads(lines[-1], parse_constant=_reject_nonfinite)
+    except ValueError as e:
+        problems.append(f"last line is not valid JSON: {e}")
+        return None, problems
+    if not isinstance(rec, dict):
+        problems.append(f"JSON line is a {type(rec).__name__}, not an object")
+        return None, problems
+    return rec, problems
+
+
+def _require(
+    rec: dict, spec: tp.Dict[str, tp.Tuple[type, ...]], problems: tp.List[str]
+) -> None:
+    for key, types in spec.items():
+        if key not in rec:
+            problems.append(f"missing required field {key!r}")
+        elif not isinstance(rec[key], types) or isinstance(rec[key], bool):
+            problems.append(
+                f"field {key!r} has type {type(rec[key]).__name__}, expected "
+                + "/".join(t.__name__ for t in types)
+            )
+
+
+def check_train_bench(rec: dict) -> tp.List[str]:
+    """bench.py profile: {metric, value, unit, vs_baseline, detail}."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {"metric": (str,), "value": Number, "unit": (str,), "detail": (dict,)},
+        problems,
+    )
+    if "vs_baseline" not in rec:
+        problems.append("missing required field 'vs_baseline'")
+    elif rec["vs_baseline"] is not None and not isinstance(rec["vs_baseline"], Number):
+        problems.append("field 'vs_baseline' must be a number or null")
+    if isinstance(rec.get("detail"), dict):
+        _require(
+            rec["detail"],
+            {"tokens_per_sec": Number, "step_ms": Number, "n_devices": (int,)},
+            problems,
+        )
+    return problems
+
+
+def check_serve_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py profile (field table: docs/SERVING.md)."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "continuous_tok_s": Number,
+            "sequential_tok_s": Number,
+            "speedup": Number,
+            "p50_token_ms": Number,
+            "p99_token_ms": Number,
+            "ttft_ms_mean": Number,
+            "hbm_paged_cache_bytes": (int,),
+            "hbm_sequential_cache_bytes": (int,),
+            "model": (dict,),
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve":
+        problems.append(f"field 'bench' is {rec.get('bench')!r}, expected 'serve'")
+    if "device_peak_bytes_in_use" not in rec:
+        problems.append("missing required field 'device_peak_bytes_in_use'")
+    elif rec["device_peak_bytes_in_use"] is not None and not isinstance(
+        rec["device_peak_bytes_in_use"], int
+    ):
+        problems.append("field 'device_peak_bytes_in_use' must be int or null")
+    return problems
+
+
+def check_graftcheck(rec: dict) -> tp.List[str]:
+    """The graftcheck CLI's own --json line."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "tool": (str,),
+            "count": (int,),
+            "suppressed": (int,),
+            "files_scanned": (int,),
+            "findings": (list,),
+        },
+        problems,
+    )
+    for i, f in enumerate(rec.get("findings", [])):
+        if not isinstance(f, dict):
+            problems.append(f"findings[{i}] is not an object")
+            continue
+        _require(
+            f,
+            {"rule": (str,), "path": (str,), "line": (int,), "message": (str,)},
+            problems,
+        )
+    return problems
+
+
+PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
+    "train": check_train_bench,
+    "serve": check_serve_bench,
+    "graftcheck": check_graftcheck,
+}
+
+
+def check_bench_stdout(
+    stdout: str, profile: str
+) -> tp.Tuple[tp.Optional[dict], tp.List[str]]:
+    """Parse + schema-check a bench process's stdout against a profile."""
+    rec, problems = parse_single_json_line(stdout)
+    if rec is not None:
+        problems.extend(PROFILES[profile](rec))
+    return rec, problems

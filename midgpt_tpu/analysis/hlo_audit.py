@@ -1,0 +1,256 @@
+"""graftcheck pass 2: compiled-artifact audits over post-optimization HLO.
+
+Extends utils/hlo.py (the parser the structural test pins already share)
+with reusable assertions that turn scheduling/parity *claims* into
+executable checks:
+
+  * `CompileCounter` — counts actual XLA backend compiles via the
+    jax.monitoring event stream, so tests can pin "N request mixes -> 0 new
+    compiles" (SERVING.md: admitting/finishing requests never recompiles)
+    and "the train step compiles exactly once".
+  * `jit_cache_size` — the jit wrapper's executable-cache population (one
+    entry per compiled program), for pinning the *total* compile set of a
+    module-level jit like sampling/serve._serve_decode_chunk.
+  * `while_body_collectives` / `assert_no_while_body_collectives` — a
+    collective census of while-loop bodies (transitive through called
+    computations), e.g. "no all-gathers inside the decode while body".
+  * `entry_parameter_dtypes` / `assert_fp32_master_params` — the SURVEY.md
+    §7.4 precision contract (fp32 master params, bf16 compute cast in-step)
+    read off the lowered train step instead of trusted from a docstring.
+
+Everything here imports jax lazily so `python -m midgpt_tpu.analysis`
+(pass 1) stays free of backend initialization.
+"""
+
+from __future__ import annotations
+
+import re
+import typing as tp
+
+from midgpt_tpu.utils.hlo import hlo_computations, while_body_names
+
+# Event recorded once per actual XLA backend compilation (jax 0.4.x:
+# jax/_src/compiler.py wraps backend.compile in record_event_duration_secs).
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(" + "|".join(COLLECTIVE_OPS) + r")(?:-start|-done)?\("
+)
+# computations referenced by an instruction (fusions, while bodies, reducers)
+_CALLEE_RE = re.compile(r"(?:calls|body|condition|to_apply)=%([\w.\-]+)")
+_ENTRY_HEADER_RE = re.compile(r"^ENTRY\s+%?[\w.\-]+\s*\((?P<args>.*)\)\s*->")
+_PARAM_TYPE_RE = re.compile(r":\s*\(?([a-z]+[0-9]*)\[")
+
+
+class CompileCounter:
+    """Counts XLA backend compiles within a `with` block.
+
+    Wraps the jax.monitoring duration-event stream (the hook jax's own
+    compile path reports through), so cache hits — the thing the serving
+    pins care about distinguishing — count zero."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def _listener(self, name: str, duration: float, **kw: tp.Any) -> None:
+        if name == BACKEND_COMPILE_EVENT:
+            self.count += 1
+
+    def __enter__(self) -> "CompileCounter":
+        import jax.monitoring
+
+        self.count = 0
+        jax.monitoring.register_event_duration_secs_listener(self._listener)
+        return self
+
+    def __exit__(self, *exc: tp.Any) -> None:
+        from jax._src import monitoring as _monitoring
+
+        _monitoring._unregister_event_duration_listener_by_callback(self._listener)
+
+
+def jit_cache_size(fn: tp.Any) -> tp.Optional[int]:
+    """Compiled-program count in a jit wrapper's cache (None if the jax
+    version does not expose it). One entry per distinct (static args,
+    input avals) combination that actually lowered + compiled."""
+    probe = getattr(fn, "_cache_size", None)
+    return probe() if callable(probe) else None
+
+
+# ----------------------------------------------------------------------
+# HLO text audits
+# ----------------------------------------------------------------------
+
+
+def _reachable(comps: tp.Dict[str, tp.List[str]], root: str) -> tp.Set[str]:
+    seen = {root}
+    frontier = [root]
+    while frontier:
+        name = frontier.pop()
+        for line in comps.get(name, ()):
+            for callee in _CALLEE_RE.findall(line):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+    return seen
+
+
+def while_body_collectives(
+    hlo_text: str, ops: tp.Sequence[str] = COLLECTIVE_OPS
+) -> tp.Dict[str, tp.List[str]]:
+    """{while_body_computation: [collective instruction lines]}, transitive
+    through computations the body calls (fusions, nested control flow)."""
+    comps = hlo_computations(hlo_text)
+    wanted = re.compile(r"\b(" + "|".join(ops) + r")(?:-start|-done)?\(")
+    census: tp.Dict[str, tp.List[str]] = {}
+    for body in sorted(while_body_names(hlo_text)):
+        hits: tp.List[str] = []
+        for comp in _reachable(comps, body):
+            hits.extend(l for l in comps.get(comp, ()) if wanted.search(l))
+        census[body] = hits
+    return census
+
+
+def assert_no_while_body_collectives(
+    hlo_text: str, ops: tp.Sequence[str] = ("all-gather",)
+) -> None:
+    census = while_body_collectives(hlo_text, ops)
+    offenders = {b: ls for b, ls in census.items() if ls}
+    assert not offenders, (
+        f"collectives {ops} found inside while bodies: "
+        + "; ".join(f"{b}: {ls[0]}" for b, ls in offenders.items())
+    )
+
+
+def entry_parameter_dtypes(hlo_text: str) -> tp.List[str]:
+    """Dtype strings of the ENTRY computation's parameters, in order."""
+    for line in hlo_text.splitlines():
+        m = _ENTRY_HEADER_RE.match(line.strip())
+        if m:
+            return _PARAM_TYPE_RE.findall(m.group("args"))
+    raise ValueError("no ENTRY computation header found in HLO text")
+
+
+def fp32_master_param_audit(hlo_text: str) -> tp.Dict[str, int]:
+    """Counts used by assert_fp32_master_params (exposed for reporting)."""
+    dtypes = entry_parameter_dtypes(hlo_text)
+    return {
+        "n_params": len(dtypes),
+        "n_f32": sum(d == "f32" for d in dtypes),
+        "n_reduced": sum(d in ("bf16", "f16") for d in dtypes),
+        "has_bf16_compute": int(" bf16[" in hlo_text or "=bf16[" in hlo_text),
+    }
+
+
+def assert_fp32_master_params(
+    hlo_text: str, expect_bf16_compute: bool = True
+) -> tp.Dict[str, int]:
+    """The SURVEY.md §7.4 precision contract on a lowered train step: every
+    floating-point ENTRY parameter (master params + optimizer state) is f32
+    — none arrive half-precision — while the program body still computes in
+    bf16 (the per-step cast). Returns the audit counts."""
+    audit = fp32_master_param_audit(hlo_text)
+    assert audit["n_reduced"] == 0, (
+        f"{audit['n_reduced']} reduced-precision entry parameters — master "
+        "params/optimizer state must be fp32 (SURVEY.md §7.4)"
+    )
+    assert audit["n_f32"] > 0, "no f32 entry parameters found — wrong program?"
+    if expect_bf16_compute:
+        assert audit["has_bf16_compute"], (
+            "no bf16 values anywhere in the program — the compute-dtype cast "
+            "is missing (or the config under audit is not bf16-compute)"
+        )
+    return audit
+
+
+# ----------------------------------------------------------------------
+# built-in audit suite (CLI --audit)
+# ----------------------------------------------------------------------
+
+
+def run_audit() -> tp.Dict[str, tp.Any]:
+    """Fast CPU-only audit of the two flagship compiled artifacts.
+
+    Lowers (a) the train step of a tiny bf16-compute config and (b) the
+    serving decode chunk, entirely against abstract inputs — no weights are
+    materialized — then runs the fp32-master and while-body-collective
+    audits. Returns a JSON-able report; raises AssertionError on violation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.config import ExperimentConfig, MeshConfig
+    from midgpt_tpu.models.gpt import GPT, GPTConfig, PagedKVCache
+    from midgpt_tpu.parallel.mesh import make_mesh
+    from midgpt_tpu.utils.hlo import lower_abstract_train_step
+
+    report: tp.Dict[str, tp.Any] = {"backend": jax.default_backend()}
+
+    mc = GPTConfig(block_size=64, vocab_size=128, n_layer=2, n_head=2, n_embd=32)
+    cfg = ExperimentConfig(
+        rundir="",
+        data_dir="",
+        learning_rate=1e-3,
+        batch_size=len(jax.devices()),
+        warmup_steps=1,
+        min_lr=1e-4,
+        lr_decay_steps=10,
+        max_steps=10,
+        beta2=0.99,
+        weight_decay=0.0,
+        eval_interval=5,
+        param_dtype="float32",
+        compute_dtype="bfloat16",
+        g_accum_iters=1,
+        shard_model=True,
+        fsdp_min_size=0,
+        mesh=MeshConfig(data=-1, fsdp=-1),
+        model_config=mc,
+    )
+    mesh = make_mesh(cfg.mesh)
+    step_hlo = lower_abstract_train_step(cfg, mesh).compile().as_text()
+    report["train_step_fp32_master"] = assert_fp32_master_params(step_hlo)
+
+    # Decode program: the serving engine's fixed-shape decode chunk. Lowered
+    # abstractly (eval_shape for params + paged cache); the while body (the
+    # lax.scan over decode steps) must stay free of all-gathers — page
+    # tables/lengths ride as plain jit inputs, nothing re-shards per step.
+    from midgpt_tpu.sampling.serve import _serve_decode_chunk
+
+    params_abs = jax.eval_shape(lambda k: GPT.init(mc, k), jax.random.PRNGKey(0))
+    cache_abs = jax.eval_shape(
+        lambda: PagedKVCache.init(mc, num_pages=9, page_size=8, dtype=jnp.float32)
+    )
+    B, max_pages = 2, 8
+    decode_hlo = (
+        _serve_decode_chunk.lower(
+            mc,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            4,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        )
+        .compile()
+        .as_text()
+    )
+    assert_no_while_body_collectives(decode_hlo)
+    census = while_body_collectives(decode_hlo)
+    report["decode_while_bodies"] = {b: len(ls) for b, ls in census.items()}
+    assert census, "decode program lowered without a while loop (scan vanished?)"
+    return report

@@ -205,8 +205,8 @@ def make_pipeline_loss(
 
     Drop-in replacement for the GSPMD loss in make_train_step (same contract
     as make_shard_map_loss): GLOBAL (B, T) arrays in, global-mean scalar
-    out, differentiable. `key` is accepted for interface parity but unused
-    (pp requires dropout 0, enforced at config construction)."""
+    out, differentiable. `key` is accepted for interface compatibility but
+    unused (pp requires dropout 0, enforced at config construction)."""
     pp = mesh.shape["pp"]
     M = microbatches or pp
 

@@ -257,6 +257,24 @@ class ServeEngine:
     def cache_hbm_bytes(self) -> int:
         return self.cache.k.nbytes + self.cache.v.nbytes
 
+    @staticmethod
+    def compile_stats() -> tp.Dict[str, tp.Optional[int]]:
+        """Compiled-program census of the serving jits (graftcheck pass-2
+        hook). The scheduling claim in the module docstring — page tables
+        and lengths are plain jit inputs, so admitting/finishing requests
+        never recompiles — is only as good as these numbers staying flat:
+        `decode` is bounded by |{(n_steps, page bucket)}|, `prefill` by
+        |{page bucket}|, regardless of request mix. Pinned by
+        tests/test_recompile_pins.py; reported by tools/bench_serve.py so
+        drivers see compile-set growth as data, not as mystery latency.
+        Process-global (module-level jits shared by every engine)."""
+        from midgpt_tpu.analysis.hlo_audit import jit_cache_size
+
+        return {
+            "prefill": jit_cache_size(_serve_prefill_chunk),
+            "decode": jit_cache_size(_serve_decode_chunk),
+        }
+
     # -- scheduling round ----------------------------------------------
 
     def step(self) -> None:

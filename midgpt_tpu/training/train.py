@@ -444,8 +444,19 @@ def train(config: ExperimentConfig) -> dict:
     t_last, tokens_since = _time.time(), 0
     # Sticky health carrier (health_flag): the previous reported loss feeds
     # the next step; once NaN, always NaN, so no later save can persist a
-    # state poisoned at an un-inspected step.
-    loss = jnp.zeros((), jnp.float32)
+    # state poisoned at an un-inspected step. Committed mesh-replicated
+    # placement, matching the step's own loss output: an uncommitted
+    # jnp.zeros here gives iteration 1 a different input-sharding aval than
+    # every later iteration, silently compiling the whole step TWICE (found
+    # by the pass-2 compile counter; pinned in tests/test_recompile_pins.py).
+    loss = jax.device_put(
+        jnp.zeros((), jnp.float32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+    from midgpt_tpu.analysis.hlo_audit import jit_cache_size
+
+    step_cache_size = functools.partial(jit_cache_size, step)
+    warned_recompile = False
     for itr in range(first_step, config.max_steps):
         if itr % config.eval_interval == 0:
             metrics["loss/train"] = evaluate(
@@ -488,6 +499,22 @@ def train(config: ExperimentConfig) -> dict:
             dt = _time.time() - t_last
             tok_s = tokens_since / dt if dt > 0 else 0.0
             t_last, tokens_since = _time.time(), 0
+            # Recompile watch (graftcheck pass-2 hook): the whole step is ONE
+            # XLA program, so its jit cache must stay at exactly one entry.
+            # Growth means some input's shape/dtype is unstable across steps
+            # — the silent per-step-recompile failure mode CLAUDE.md warns
+            # about, easily >10x wall-clock, invisible in the loss. Warn at
+            # the already-paid log sync; pinned in tests/test_recompile_pins.py.
+            n_programs = step_cache_size()
+            if n_programs is not None and n_programs > 1 and not warned_recompile:
+                warned_recompile = True
+                if jax.process_index() == 0:
+                    print(
+                        f"WARNING: train step has compiled {n_programs} distinct "
+                        "programs — input shapes/dtypes are unstable across "
+                        "steps and every recompile stalls the device "
+                        "(run graftcheck --audit / check batch shapes)"
+                    )
             metrics.update(
                 {
                     "loss/optimized": loss_f,

@@ -13,25 +13,34 @@ import re
 import typing as tp
 
 
+_HLO_HEADER_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
+
+
 def hlo_computations(txt: str) -> tp.Dict[str, tp.List[str]]:
     """Parse post-optimization HLO text into {computation: instruction lines}.
 
     Computation headers look like `%name (args) -> type {` (ENTRY-prefixed
-    for main); instructions are the indented lines until the closing `}`.
+    for main, `%` optional across jax/XLA versions); instructions are the
+    lines until the closing `}` (tolerated indented). A header encountered
+    while a computation is still open — a malformed dump missing its closing
+    brace — starts the new computation rather than silently glomming its
+    instructions onto the previous one; braces *inside* instruction lines
+    (layout annotations `{1,0}`, nested constant literals `{ {1,2} }`,
+    metadata) never open or close a computation. Edge cases pinned by
+    tests/test_hlo_utils.py.
     """
     comps: tp.Dict[str, tp.List[str]] = {}
     name = None
     for raw in txt.splitlines():
-        line = raw.rstrip()
-        if name is None:
-            m = re.match(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(", line)
-            if m and line.endswith("{"):
-                name = m.group(1)
-                comps[name] = []
+        line = raw.strip()
+        m = _HLO_HEADER_RE.match(line)
+        if m and line.endswith("{"):
+            name = m.group(1)
+            comps[name] = []
         elif line == "}":
             name = None
-        else:
-            comps[name].append(line.strip())
+        elif name is not None:
+            comps[name].append(line)
     return comps
 
 

@@ -1,0 +1,119 @@
+"""The driver contract, executed: bench.py and tools/bench_serve.py must
+emit exactly ONE schema-conformant JSON line on stdout. Runs the real
+entry-point main()s in-process (tiny shapes, CPU mesh) and validates their
+stdout through the shared checker in analysis/bench_contract.py — the one
+place the contract is written down, so a silently renamed field or a stray
+print fails here instead of in the driver."""
+
+import json
+import os
+import runpy
+import sys
+
+from midgpt_tpu.analysis.bench_contract import (
+    check_bench_stdout,
+    check_serve_bench,
+    check_train_bench,
+    parse_single_json_line,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_entry_point(path, argv, capsys):
+    """Load a script module and run its main() with patched argv, returning
+    captured stdout (run_name != '__main__' so nothing auto-executes)."""
+    mod = runpy.run_path(path, run_name="bench_under_test")
+    old_argv = sys.argv
+    sys.argv = argv
+    try:
+        rc = mod["main"]()
+    finally:
+        sys.argv = old_argv
+    assert rc == 0
+    return capsys.readouterr().out
+
+
+def test_bench_serve_emits_conformant_json_line(capsys):
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--n-requests", "3",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve")
+    assert not problems, problems
+    assert rec["n_requests"] == 3
+    assert rec["continuous_tok_s"] > 0 and rec["sequential_tok_s"] > 0
+    # the counter hooks ride along: serving compiled a bounded program set
+    assert rec["compile_counts"]["decode"] >= 1
+    assert rec["compile_counts"]["prefill"] >= 1
+
+
+def test_bench_train_emits_conformant_json_line(capsys):
+    out = _run_entry_point(
+        os.path.join(REPO, "bench.py"),
+        [
+            "bench.py",
+            "--steps", "1",
+            "--warmup", "1",
+            "--batch", "1",
+            "--layers", "1",
+            "--seq", "64",
+            "--vocab", "256",
+            "--attn", "naive",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "train")
+    assert not problems, problems
+    assert rec["metric"].startswith("train_mfu_124m_naive")
+    assert rec["detail"]["seq_len"] == 64 and rec["detail"]["n_devices"] == 8
+
+
+# ----------------------------------------------------------------------
+# checker unit behavior (no bench run needed)
+# ----------------------------------------------------------------------
+
+
+def test_checker_rejects_multiline_and_nonjson():
+    rec, problems = parse_single_json_line('{"a": 1}\nextra line\n')
+    assert any("exactly 1" in p for p in problems)
+    rec, problems = parse_single_json_line("not json at all\n")
+    assert rec is None and any("not valid JSON" in p for p in problems)
+
+
+def test_checker_rejects_nan():
+    """json.dumps happily emits bare NaN — which no strict consumer parses.
+    The checker must treat it as a contract violation, not a number."""
+    line = json.dumps({"metric": "m", "value": float("nan")}) + "\n"
+    rec, problems = parse_single_json_line(line)
+    assert rec is None and any("NaN" in p or "non-finite" in p for p in problems)
+
+
+def test_checker_catches_field_drift():
+    good = {
+        "metric": "train_mfu",
+        "value": 48.5,
+        "unit": "% MFU",
+        "vs_baseline": 1.01,
+        "detail": {"tokens_per_sec": 1.0, "step_ms": 2.0, "n_devices": 1},
+    }
+    assert check_train_bench(good) == []
+    renamed = dict(good)
+    renamed["vs_base"] = renamed.pop("vs_baseline")
+    assert any("vs_baseline" in p for p in check_train_bench(renamed))
+    wrong_type = dict(good, value="48.5")
+    assert any("value" in p for p in check_train_bench(wrong_type))
+    assert any(
+        "bench" in p for p in check_serve_bench({"bench": "other"})
+    )

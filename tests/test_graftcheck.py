@@ -1,0 +1,209 @@
+"""graftcheck pass-1 lint: one deliberate-violation fixture per rule
+(GC001-GC006), suppression semantics, and the CLI contract (nonzero exit
+with rule ID + file:line on violations; --json is one schema-conformant
+line). The repo-wide "tree is clean" gate lives in tests/test_lint_clean.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from midgpt_tpu.analysis.bench_contract import check_bench_stdout
+from midgpt_tpu.analysis.lint import lint_source, parse_suppressions
+
+# One minimal violating snippet per rule; (rule, expected line) is asserted
+# exactly so a rule that silently stops firing fails loudly here.
+FIXTURES = {
+    "GC001": (
+        """\
+import jax
+from jax.experimental import pallas as pl
+
+def _kern(x_ref, o_ref):
+    o_ref[0] = jax.lax.cond(x_ref[0] > 0, lambda: x_ref[0], lambda: x_ref[1])
+
+def run(x):
+    return pl.pallas_call(_kern, out_shape=x)(x)
+""",
+        5,
+    ),
+    "GC002": (
+        """\
+import jax
+
+@jax.jit
+def f(x):
+    return float(x) + 1.0
+""",
+        5,
+    ),
+    "GC003": (
+        """\
+from jax.experimental import pallas as pl
+
+spec = pl.BlockSpec((4, 100), lambda i: (i, 0))
+""",
+        3,
+    ),
+    "GC004": (
+        """\
+import functools
+
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def f(buf, x):
+    return buf + x
+
+def run(buf, x):
+    y = f(buf, x)
+    return y + buf.sum()
+""",
+        11,
+    ),
+    "GC005": (
+        """\
+import time
+
+import jax
+
+@jax.jit
+def f(x):
+    return x + time.time()
+""",
+        7,
+    ),
+    "GC006": (
+        """\
+def attn(q):
+    \"\"\"Numerical parity with the fused path is exact.\"\"\"
+    return q
+""",
+        1,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_each_rule_fires_on_its_fixture(rule):
+    src, line = FIXTURES[rule]
+    active, suppressed = lint_source(src, f"{rule}.py")
+    assert [(f.rule, f.line) for f in active] == [(rule, line)], active
+    assert not suppressed
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_each_rule_suppressible_inline(rule):
+    src, line = FIXTURES[rule]
+    lines = src.splitlines()
+    lines[line - 1] += f"  # graftcheck: disable={rule} — fixture: rule under test"
+    active, suppressed = lint_source("\n".join(lines) + "\n", f"{rule}.py")
+    assert active == []
+    assert [(f.rule, f.line) for f in suppressed] == [(rule, line)]
+
+
+def test_suppression_justification_is_captured():
+    src = "x = 1  # graftcheck: disable=GC003 — spans the full array dim\n"
+    (s,) = parse_suppressions(src)
+    assert s.rules == ("GC003",) and s.line == 1
+    assert "full array dim" in s.justification
+
+
+def test_clean_code_with_traced_scopes_passes():
+    src = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    n = int(x.shape[0])  # static shape math is not a host sync
+    return x * n + float("-inf")
+"""
+    active, _ = lint_source(src, "clean.py")
+    assert active == []
+
+
+def test_gc004_accepts_rebinding_and_flags_loop_reuse():
+    ok = """\
+import functools
+
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def f(buf, x):
+    return buf + x
+
+def run(buf, xs):
+    for x in xs:
+        buf = f(buf, x)
+    return buf
+"""
+    active, _ = lint_source(ok, "ok.py")
+    assert active == []
+    bad = ok.replace("        buf = f(buf, x)", "        out = f(buf, x)").replace(
+        "    return buf\n", "    return out\n"
+    )
+    active, _ = lint_source(bad, "bad.py")
+    assert [f.rule for f in active] == ["GC004"]
+
+
+def test_gc006_accepts_reference_or_test_citation():
+    for cite in ("reference model.py:76", "tests/test_flash.py"):
+        src = f'def f(q):\n    """Parity pinned ({cite})."""\n    return q\n'
+        active, _ = lint_source(src, "cited.py")
+        assert active == [], cite
+
+
+# ----------------------------------------------------------------------
+# CLI contract
+# ----------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "midgpt_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+
+
+def test_cli_nonzero_with_rule_id_and_location_per_fixture(tmp_path):
+    """The acceptance pin: the CLI exits nonzero on the fixture violations
+    and names each one by rule ID and file:line."""
+    expected = []
+    for rule, (src, line) in FIXTURES.items():
+        p = tmp_path / f"fixture_{rule.lower()}.py"
+        p.write_text(src)
+        expected.append((rule, str(p), line))
+    proc = _run_cli("--json", str(tmp_path))
+    assert proc.returncode == 1, proc.stderr
+    rec, problems = check_bench_stdout(proc.stdout, "graftcheck")
+    assert not problems, problems
+    assert rec["count"] == len(FIXTURES)
+    got = {(f["rule"], f["path"], f["line"]) for f in rec["findings"]}
+    for rule, path, line in expected:
+        assert (rule, path, line) in got, (rule, got)
+
+
+def test_cli_exit_zero_on_clean_file(tmp_path):
+    p = tmp_path / "clean.py"
+    p.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x + 1\n")
+    proc = _run_cli(str(p))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rules_subset(tmp_path):
+    """--rules narrows the run; unknown rules are a usage error."""
+    p = tmp_path / "two.py"
+    p.write_text(FIXTURES["GC003"][0] + FIXTURES["GC006"][0])
+    proc = _run_cli("--json", "--rules", "GC006", str(p))
+    rec, problems = check_bench_stdout(proc.stdout, "graftcheck")
+    assert not problems, problems
+    assert [f["rule"] for f in rec["findings"]] == ["GC006"]
+    assert _run_cli("--rules", "GC999", str(p)).returncode == 2

@@ -1,0 +1,132 @@
+"""Direct unit tests for the utils/hlo.py parser (previously exercised only
+through the structural pins that consume it) and the analysis/hlo_audit.py
+text-level audits built on top of it."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from midgpt_tpu.analysis.hlo_audit import (
+    CompileCounter,
+    assert_fp32_master_params,
+    assert_no_while_body_collectives,
+    entry_parameter_dtypes,
+    fp32_master_param_audit,
+    jit_cache_size,
+    while_body_collectives,
+)
+from midgpt_tpu.utils.hlo import hlo_computations, while_body_names
+
+# Shaped like a post-optimization dump: layout annotations and a nested-brace
+# constant inside instruction lines, an indented closing brace, and a while
+# whose body computation calls a fusion holding an all-gather.
+SAMPLE_HLO = """\
+HloModule test, entry_computation_layout={(f32[4]{0})->f32[4]{0}}
+
+%fused_computation (param_0: f32[4]) -> f32[4] {
+  %param_0 = f32[4]{0} parameter(0)
+  %c = f32[2,2]{1,0} constant({ {1, 2}, {3, 4} })
+  ROOT %ag = f32[4]{0} all-gather(f32[4]{0} %param_0), replica_groups={}
+  }
+
+%region_0.22 (arg_tuple.23: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %arg_tuple.23 = (s32[], f32[4]{0}) parameter(0)
+  %f = f32[4]{0} fusion(f32[4]{0} %gte), kind=kLoop, calls=%fused_computation
+}
+
+%region_2.47 (arg_tuple.48: (s32[], f32[4])) -> pred[] {
+  %arg_tuple.48 = (s32[], f32[4]{0}) parameter(0)
+}
+
+ENTRY %main.62 (Arg_0.1: f32[4], Arg_1.2: bf16[4], Arg_2.3: s32[]) -> f32[4] {
+  %w = (s32[], f32[4]{0}) while((s32[], f32[4]{0}) %t), condition=%region_2.47, body=%region_0.22
+}
+"""
+
+
+def test_hlo_computations_parses_bodies_and_nested_braces():
+    comps = hlo_computations(SAMPLE_HLO)
+    assert set(comps) == {"fused_computation", "region_0.22", "region_2.47", "main.62"}
+    # the nested-brace constant is ONE instruction line, not a scope change
+    assert any("constant({ {1, 2}, {3, 4} })" in l for l in comps["fused_computation"])
+    assert len(comps["region_0.22"]) == 2
+    # indented closing brace (fused_computation) still closed the scope
+    assert all("parameter(0)" not in l for l in comps["region_2.47"][1:])
+
+
+def test_hlo_computations_malformed_missing_close():
+    """A header met while a computation is still open (truncated/malformed
+    dump) starts the new computation instead of glomming instructions."""
+    txt = (
+        "%a (x: f32[]) -> f32[] {\n"
+        "  %i1 = f32[] parameter(0)\n"
+        "%b (y: f32[]) -> f32[] {\n"
+        "  %i2 = f32[] parameter(0)\n"
+        "}\n"
+    )
+    comps = hlo_computations(txt)
+    assert [l for l in comps["a"]] == ["%i1 = f32[] parameter(0)"]
+    assert [l for l in comps["b"]] == ["%i2 = f32[] parameter(0)"]
+
+
+def test_hlo_computations_header_without_brace_is_not_a_computation():
+    txt = "%notacomp (x: f32[])\n%real (y: f32[]) -> f32[] {\n  %i = f32[] parameter(0)\n}\n"
+    comps = hlo_computations(txt)
+    assert set(comps) == {"real"}
+
+
+def test_while_body_names_and_census():
+    assert while_body_names(SAMPLE_HLO) == {"region_0.22"}
+    census = while_body_collectives(SAMPLE_HLO)
+    # transitive: the all-gather hides inside a fusion the body calls
+    assert [l for l in census["region_0.22"] if "all-gather" in l]
+    with pytest.raises(AssertionError, match="all-gather"):
+        assert_no_while_body_collectives(SAMPLE_HLO)
+    assert_no_while_body_collectives(SAMPLE_HLO, ops=("all-to-all",))
+
+
+def test_entry_parameter_dtypes_and_fp32_audit():
+    assert entry_parameter_dtypes(SAMPLE_HLO) == ["f32", "bf16", "s32"]
+    audit = fp32_master_param_audit(SAMPLE_HLO)
+    assert audit == {"n_params": 3, "n_f32": 1, "n_reduced": 1, "has_bf16_compute": 1}
+    with pytest.raises(AssertionError, match="fp32"):
+        assert_fp32_master_params(SAMPLE_HLO)
+    with pytest.raises(ValueError, match="ENTRY"):
+        entry_parameter_dtypes("HloModule empty\n")
+
+
+def test_parser_roundtrip_on_real_lowering():
+    """End-to-end sanity on an actual compiled scan: the while body exists,
+    parses, and is collective-free on one device."""
+
+    @jax.jit
+    def f(x):
+        def body(c, _):
+            return c * 1.5 + 1.0, None
+
+        c, _ = jax.lax.scan(body, x, None, length=4)
+        return c
+
+    txt = f.lower(jnp.ones((8,), jnp.float32)).compile().as_text()
+    comps = hlo_computations(txt)
+    bodies = while_body_names(txt)
+    assert bodies and bodies <= set(comps)
+    assert_no_while_body_collectives(txt)
+    assert entry_parameter_dtypes(txt) == ["f32"]
+
+
+def test_compile_counter_and_cache_size():
+    f = jax.jit(lambda x: x * 3 + 2)
+    assert jit_cache_size(f) == 0
+    with CompileCounter() as cc:
+        f(jnp.ones((5, 3)))
+    assert cc.count >= 1
+    assert jit_cache_size(f) == 1
+    with CompileCounter() as cc2:
+        f(jnp.zeros((5, 3)))  # same shape/dtype: cache hit
+    assert cc2.count == 0
+    assert jit_cache_size(f) == 1
+    with CompileCounter() as cc3:
+        f(jnp.ones((2, 9)))  # new shape: recompile
+    assert cc3.count >= 1
+    assert jit_cache_size(f) == 2

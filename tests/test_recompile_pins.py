@@ -1,0 +1,161 @@
+"""graftcheck pass-2 recompile pins: the compile-behavior claims of PR 1's
+serving engine and the training step, held by counter instead of comment.
+
+* ServeEngine (SERVING.md): page tables / lengths / active masks are plain
+  jit inputs and chunk shapes are padded/pow2-bucketed, so a CHANGING
+  REQUEST MIX never recompiles — the decode program compiles exactly once,
+  prefill once per pow2 page bucket, and replaying three further distinct
+  mixes compiles nothing at all.
+* Train step (training/train.py): the whole step is ONE XLA program; three
+  steps, one compile.
+* The compiled artifacts themselves: no all-gathers in the decode while
+  body, fp32 master params + bf16 compute in the lowered train step
+  (SURVEY.md §7.4) — via analysis.hlo_audit.run_audit, the same suite
+  `python -m midgpt_tpu.analysis --audit` runs.
+
+Mix design (why these exact numbers pin "exactly one decode program"):
+decode_chunk=8 and every request's max_new_tokens ≡ 1 (mod 8) — the first
+generated token is sampled host-side at end of prefill, so the decode-side
+remainder is a multiple of 8 and every decode round runs a full chunk
+(n_steps=8); prompts are 25..47 tokens with prompt+max_new <= block_size=64,
+so the pow2 page bucket is pinned at the 8-page cap from the first decode
+round and the pool (24 allocatable pages) never forces an eviction. Any
+scheduler change that starts re-bucketing or splitting chunks shows up here
+as a compile-count bump.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.analysis.hlo_audit import CompileCounter, jit_cache_size, run_audit
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.parallel.data import make_global_batch
+from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
+from midgpt_tpu.sampling.serve import (
+    ServeEngine,
+    _serve_decode_chunk,
+    _serve_prefill_chunk,
+)
+from midgpt_tpu.training.train import init_state, make_train_step
+
+CFG = GPTConfig(block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT.init(CFG, jax.random.PRNGKey(0))
+
+
+def _serve_mix(params, lengths, max_new, seed):
+    eng = ServeEngine(
+        CFG,
+        params,
+        max_slots=3,
+        page_size=8,
+        num_pages=25,  # full working set fits: no eviction churn in the pin
+        prefill_chunk=16,
+        decode_chunk=8,
+        temperature=0.0,
+        cache_dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(seed)
+    uids = {
+        eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m): (n, m)
+        for n, m in zip(lengths, max_new)
+    }
+    done = eng.run()
+    assert set(done) == set(uids)
+    for uid, (n, m) in uids.items():
+        assert len(done[uid].tokens) == n + m
+    return eng
+
+
+def test_serve_mixes_exactly_one_decode_compile(params):
+    """The acceptance pin: >= 3 distinct request mixes, 1 decode-program
+    compile total — and zero compiles of any kind after the first mix."""
+    d0 = jit_cache_size(_serve_decode_chunk)
+    p0 = jit_cache_size(_serve_prefill_chunk)
+    eng = _serve_mix(params, (25, 34, 47), (9, 17, 17), seed=0)
+    d1 = jit_cache_size(_serve_decode_chunk)
+    assert d1 - d0 == 1, "decode must be ONE program (fixed n_steps x bucket)"
+    # prefill compiles once per pow2 page bucket the mix touches: {2, 4, 8}
+    assert jit_cache_size(_serve_prefill_chunk) - p0 == 3
+    stats = eng.compile_stats()
+    assert stats["decode"] == d1 and stats["prefill"] == p0 + 3
+
+    with CompileCounter() as cc:
+        _serve_mix(params, (26, 33, 40), (9, 17, 9), seed=1)
+        _serve_mix(params, (29, 41, 45), (17, 9, 17), seed=2)
+        _serve_mix(params, (31, 38, 47), (17, 17, 9), seed=3)
+    assert cc.count == 0, f"request-mix change recompiled {cc.count} program(s)"
+    assert jit_cache_size(_serve_decode_chunk) == d1
+
+
+def test_train_step_compiles_exactly_once():
+    cfg = ExperimentConfig(
+        rundir="",
+        data_dir="",
+        learning_rate=1e-2,
+        batch_size=8,
+        warmup_steps=5,
+        min_lr=1e-3,
+        lr_decay_steps=60,
+        max_steps=60,
+        beta2=0.99,
+        weight_decay=1e-4,
+        eval_interval=30,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=1,
+        shard_model=True,
+        mesh=MeshConfig(data=2, fsdp=4, sp=1),
+        fsdp_min_size=0,
+        model_config=CFG,
+    )
+    mesh = make_mesh(cfg.mesh)
+    p, opt, specs, optimizer = init_state(cfg, mesh)
+    step, _, _ = make_train_step(cfg, optimizer, mesh, specs)
+    rng = np.random.default_rng(0)
+    T = CFG.block_size
+
+    def batch(i):
+        x = rng.integers(0, CFG.vocab_size, (1, 8, T), dtype=np.int32)
+        return make_global_batch(x, mesh, batch_spec()), make_global_batch(
+            np.roll(x, -1, -1), mesh, batch_spec()
+        )
+
+    key = jax.random.PRNGKey(0)
+    # Warm step 0 exactly as the train loop calls it: the sticky-loss
+    # carrier is a COMMITTED mesh-replicated f32 scalar from the start
+    # (training/train.py). Both an uncommitted zeros() and the bare-float
+    # default would give step 0 a different input aval than step 1+ and
+    # compile the whole step twice — the original shipped loop did exactly
+    # that, and this pin is what caught it.
+    loss = jax.device_put(
+        jnp.zeros((), jnp.float32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+    x, y = batch(0)
+    p, opt, loss = step(p, opt, x, y, jax.random.fold_in(key, 0), loss)
+    assert jit_cache_size(step) == 1
+    with CompileCounter() as cc:
+        for i in (1, 2):
+            x, y = batch(i)
+            p, opt, loss = step(p, opt, x, y, jax.random.fold_in(key, i), loss)
+    assert cc.count == 0, "train step recompiled on a later step"
+    assert jit_cache_size(step) == 1
+    assert np.isfinite(float(loss))
+
+
+def test_audit_suite_passes_on_cpu_mesh():
+    """run_audit = what `python -m midgpt_tpu.analysis --audit` executes:
+    fp32 master params + bf16 compute on the lowered train step, and a
+    collective-free decode while body. Raises on violation."""
+    report = run_audit()
+    fp = report["train_step_fp32_master"]
+    assert fp["n_reduced"] == 0 and fp["n_f32"] > 0 and fp["has_bf16_compute"]
+    assert report["decode_while_bodies"], "decode program lost its scan?"
+    assert all(n == 0 for n in report["decode_while_bodies"].values())

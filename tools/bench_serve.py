@@ -174,6 +174,10 @@ def main() -> int:
                 "hbm_paged_cache_bytes": int(paged_bytes),
                 "hbm_sequential_cache_bytes": int(contiguous_bytes),
                 "device_peak_bytes_in_use": peak,
+                # Compiled-program census (ServeEngine.compile_stats): the
+                # "request churn never recompiles" claim as a number drivers
+                # can watch for drift (schema: analysis/bench_contract.py).
+                "compile_counts": ServeEngine.compile_stats(),
             }
         )
     )

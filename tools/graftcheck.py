@@ -1,0 +1,18 @@
+"""Wrapper for `python -m midgpt_tpu.analysis` runnable straight from a
+checkout (adds the repo root to sys.path, same convention as the other
+tools/ entry points). All arguments pass through; see docs/ANALYSIS.md.
+
+    python tools/graftcheck.py [paths...] [--json] [--audit] [--rules ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from midgpt_tpu.analysis.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())
