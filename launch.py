@@ -6,9 +6,10 @@
 Behavior parity: dynamic config import by name, timestamped rundir default,
 config.json persisted to the rundir (local or gs://) for sample-time
 reconstruction, wandb-id persistence for resume (when wandb is installed),
-cross-host barrier after proc-0 setup, then train(). `--set` dotted overrides
-(e.g. --set max_steps=100 --set model_config.n_layer=4) are an addition the
-reference lacks.
+cross-host barrier after proc-0 setup, then the supervised train loop
+(robustness/supervisor.py: restart-on-divergence + SIGTERM/SIGINT emergency
+checkpointing). `--set` dotted overrides (e.g. --set max_steps=100 --set
+model_config.n_layer=4) are an addition the reference lacks.
 """
 
 from __future__ import annotations
@@ -94,7 +95,8 @@ def main() -> None:
         jax.distributed.initialize()
 
     from midgpt_tpu.config import load_config, to_json
-    from midgpt_tpu.training.train import train
+    from midgpt_tpu.robustness import preempt
+    from midgpt_tpu.robustness.supervisor import supervise
 
     config = load_config(args.config)
     if args.set:
@@ -134,7 +136,11 @@ def main() -> None:
         sync_global_devices("end_setup")
 
     print(config)
-    train(config)
+    # SIGTERM/SIGINT -> emergency checkpoint at the next step boundary, then
+    # a clean exit (a second signal hard-kills). The supervisor adds
+    # restart-on-divergence with data-window skip (docs/ROBUSTNESS.md).
+    preempt.install_handlers()
+    supervise(config)
 
 
 if __name__ == "__main__":

@@ -14,6 +14,9 @@ the CLAUDE.md / RESULTS.md citations live in docs/ANALYSIS.md):
          scopes (baked in at trace time — silently constant).
   GC006  function docstrings claiming parity without a `reference file:line`
          citation or a pinning-test citation (tests/...py).
+  GC007  bare/broad `except` that swallows failures of checkpoint or
+         collective call sites (a silently-dropped save/restore/collective
+         is how runs lose state or deadlock half a mesh — robustness PR).
 
 Scope model: a function is *traced* if it is jit-decorated (including
 `functools.partial(jax.jit, ...)` and `name = jax.jit(fn)` rebinding), a
@@ -46,6 +49,7 @@ RULES: tp.Dict[str, str] = {
     "GC004": "donated argument read after the donating call site",
     "GC005": "wall clock / numpy RNG reachable from a traced scope",
     "GC006": "parity claim without a reference or pinning-test citation",
+    "GC007": "swallowed exception around a checkpoint/collective call site",
 }
 
 # Default lint roots, relative to the repo root (tests are excluded on
@@ -583,6 +587,83 @@ def _rule_gc006(mod: _Module) -> tp.Iterator[Finding]:
         )
 
 
+# Leaf names of checkpoint-manager and cross-device/host collective calls:
+# the operations whose failure must never be silently dropped (a swallowed
+# save means lost state; a swallowed collective means half the mesh enters
+# the op and deadlocks). Dotted calls only — bare local helpers named `save`
+# are not checkpoint ops.
+_GC007_LEAVES = frozenset(
+    {
+        "save",
+        "restore",
+        "wait_until_finished",
+        "check_for_errors",
+        "delete",
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_reduce",
+        "ppermute",
+        "all_to_all",
+        "sync_global_devices",
+        "process_allgather",
+        "broadcast_one_to_all",
+    }
+)
+
+
+def _gc007_broad(handler: ast.ExceptHandler) -> tp.Optional[str]:
+    """The broad class name a handler catches, or None if it is specific."""
+    t = handler.type
+    if t is None:
+        return "<bare>"
+    names = [e for e in (t.elts if isinstance(t, ast.Tuple) else [t])]
+    for e in names:
+        d = _dotted(e)
+        if d in ("Exception", "BaseException"):
+            return d
+    return None
+
+
+def _rule_gc007(mod: _Module) -> tp.Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        calls: tp.Set[str] = set()
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    name = _call_name(sub)
+                    if name and "." in name and name.split(".")[-1] in _GC007_LEAVES:
+                        calls.add(name)
+        if not calls:
+            continue
+        for handler in node.handlers:
+            broad = _gc007_broad(handler)
+            if broad is None:
+                continue
+            swallows = not any(
+                isinstance(sub, ast.Raise)
+                for stmt in handler.body
+                for sub in ast.walk(stmt)
+            )
+            if swallows:
+                caught = "bare `except:`" if broad == "<bare>" else f"`except {broad}`"
+                yield Finding(
+                    "GC007",
+                    mod.path,
+                    handler.lineno,
+                    handler.col_offset,
+                    f"{caught} swallows failures of checkpoint/collective "
+                    f"call(s) {sorted(calls)} — a dropped save/restore loses "
+                    "state and a dropped collective deadlocks the mesh; "
+                    "catch specific exceptions or re-raise (suppress with "
+                    "justification if the swallow is deliberate)",
+                )
+
+
 _ALL_RULES = (
     _rule_gc001,
     _rule_gc002,
@@ -590,6 +671,7 @@ _ALL_RULES = (
     _rule_gc004,
     _rule_gc005,
     _rule_gc006,
+    _rule_gc007,
 )
 
 

@@ -98,6 +98,34 @@ class ExperimentConfig:
     # '1f1b' (interleaved fwd/bwd, 2*pp-slot stash independent of
     # microbatch count — parallel/pipeline.py make_pipeline_loss_and_grad).
     pipeline_schedule: str = "gpipe"
+    # ---- robustness (midgpt_tpu/robustness, docs/ROBUSTNESS.md) ----
+    # Constant added to the loop iteration before it indexes the positional
+    # data sampler / dropout-key stream. The supervisor advances it on a
+    # divergence rollback so the resumed run samples PAST the poisoned data
+    # window; 0 (default) is the plain trajectory.
+    data_step_offset: int = 0
+    # Divergence-restart budget of supervisor.supervise (0 disables
+    # rollback: the first divergence raises straight through, the pre-PR
+    # behavior).
+    max_restarts: int = 2
+    # Base of the supervisor's exponential restart backoff (sleep
+    # restart_backoff_sec * 2**attempt between rollbacks).
+    restart_backoff_sec: float = 1.0
+    # Verified checkpoints kept on disk. 2 (not 1): the previous checkpoint
+    # must outlive the next save's verification, or a crash mid-save can
+    # destroy the only good state.
+    ckpt_max_to_keep: int = 2
+    # Retry budget / backoff base for the synchronous part of a checkpoint
+    # save (transient TensorStore/filesystem failures).
+    ckpt_write_retries: int = 3
+    ckpt_retry_backoff_sec: float = 0.5
+    # Poll the preemption flag every N steps. 1 is free single-process; on
+    # multihost every check is a tiny cross-host all-gather (robustness/
+    # preempt.py), so large fleets may want a coarser cadence.
+    preempt_check_interval: int = 1
+    # Fault-injection plan ("kind[@step][*times],..." — robustness/faults.py),
+    # activated once per supervised run; "" (default) injects nothing.
+    fault_plan: str = ""
     debug: bool = False
 
     def __post_init__(self):
@@ -257,6 +285,22 @@ class ExperimentConfig:
         sp = self.mesh.sp
         if sp == -1:
             sp = 1
+        if self.data_step_offset < 0:
+            # A negative offset would re-sample windows already consumed
+            # before the rollback — the exact data the skip exists to avoid.
+            raise ValueError(f"data_step_offset={self.data_step_offset} must be >= 0")
+        if self.max_restarts < 0:
+            raise ValueError(f"max_restarts={self.max_restarts} must be >= 0")
+        if self.ckpt_max_to_keep < 1:
+            raise ValueError(f"ckpt_max_to_keep={self.ckpt_max_to_keep} must be >= 1")
+        if self.ckpt_write_retries < 1:
+            raise ValueError(f"ckpt_write_retries={self.ckpt_write_retries} must be >= 1")
+        if self.preempt_check_interval < 1:
+            raise ValueError(
+                f"preempt_check_interval={self.preempt_check_interval} must be >= 1"
+            )
+        if self.restart_backoff_sec < 0 or self.ckpt_retry_backoff_sec < 0:
+            raise ValueError("backoff seconds must be >= 0")
         if mc.attn_impl == "ulysses":
             # Ulysses re-shards heads over sp (after any tp head sharding):
             # every (tp, sp) device needs whole heads.

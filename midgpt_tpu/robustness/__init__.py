@@ -1,0 +1,33 @@
+"""Fault tolerance: run supervision, preemption handling, fault injection.
+
+The recovery model (docs/ROBUSTNESS.md) is built on two properties the rest
+of the framework already guarantees:
+
+  * the data sampler is positional (`data/dataset.py`: every batch is a pure
+    function of (seed, split, step)) and the dropout key stream is
+    step-folded (`training/train.py`), so resume-and-replay is exactly
+    deterministic with zero sampler state to checkpoint;
+  * training health is sticky (`training/train.py health_flag`): a NaN/Inf
+    anywhere surfaces in the reported loss at the next log/save sync and no
+    poisoned state can reach the rolling checkpoint.
+
+This package adds the machinery on top: `supervisor.supervise` restarts a
+diverged run from the last *verified* checkpoint with the poisoned data
+window skipped; `preempt` turns SIGTERM/SIGINT into an emergency save at
+the next step boundary; `faults` injects failures so all of it is testable
+end to end on the CPU mesh (tools/chaos_run.py drives the same registry).
+"""
+
+from midgpt_tpu.robustness.errors import (
+    CheckpointCorruptError,
+    CheckpointWriteError,
+    DivergenceError,
+    SimulatedPreemption,
+)
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointWriteError",
+    "DivergenceError",
+    "SimulatedPreemption",
+]

@@ -1,0 +1,60 @@
+"""Exception types shared by the training loop, checkpointing, and the run
+supervisor. Deliberately dependency-free (no jax import) so every layer can
+import them without ordering constraints.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+
+class DivergenceError(FloatingPointError):
+    """Training produced a non-finite loss/grad (the sticky health carrier).
+
+    Subclasses FloatingPointError so existing callers that catch/match the
+    pre-supervisor divergence guard keep working; carries the structured
+    fields the supervisor needs to roll back and skip the poisoned window.
+
+    `step` is the loop iteration at which the poisoning was *noticed* (a log
+    or save sync); the actual bad batch lies in (last_good_step, step] —
+    stickiness guarantees it cannot be earlier than the last verified save.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        step: int,
+        last_good_step: tp.Optional[int] = None,
+        rundir: str = "",
+    ):
+        super().__init__(message)
+        self.step = step
+        self.last_good_step = last_good_step
+        self.rundir = rundir
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed its manifest verification (missing/truncated/
+    bit-flipped item). `problems` lists one human-readable line per
+    mismatch."""
+
+    def __init__(self, message: str, *, step: int, problems: tp.Sequence[str] = ()):
+        super().__init__(message)
+        self.step = step
+        self.problems = list(problems)
+
+
+class CheckpointWriteError(OSError):
+    """A checkpoint save still failed after the configured retry budget."""
+
+
+class SimulatedPreemption(BaseException):
+    """Raised by the `kill_mid_save` fault to model the process dying between
+    the TensorStore write and the manifest commit.
+
+    Subclasses BaseException (like KeyboardInterrupt) on purpose: a real
+    SIGKILL is not catchable, so no `except Exception` recovery path may
+    swallow its simulation either — only the fault-injection tests catch it
+    explicitly.
+    """

@@ -1,0 +1,121 @@
+"""Fault-injection registry: named, bounded failures for recovery testing.
+
+A fault is (kind, optional step, remaining firings). Production code calls
+`should_fire(kind, step=...)` at the few places a real failure would strike;
+with an empty registry (the default, always) that is a list scan over
+nothing — no fault machinery is reachable unless a plan was activated.
+
+Kinds (each exercised end to end by tests/test_robustness.py and drivable
+via tools/chaos_run.py):
+
+  nan_grad           poison the train step's sticky loss carrier at data
+                     step k — models a bad batch NaN-ing the gradients. The
+                     key is the DATA step index (itr + data_step_offset), so
+                     a supervisor rollback that skips the window also skips
+                     the fault, exactly like a real poisoned shard.
+  ckpt_io_error      raise IOError from the next N checkpoint-save attempts
+                     (a transient TensorStore/filesystem failure) — the
+                     manager's retry/backoff must absorb it.
+  kill_mid_save      after the TensorStore write lands, truncate one item
+                     and raise SimulatedPreemption before the manifest is
+                     written — models SIGKILL between write and commit.
+  truncate_ckpt_item truncate one item file AFTER the manifest committed —
+                     models later corruption (bit rot, partial copy);
+                     verification must catch it at restore/resume time.
+  preempt            set the preemption flag at data step k, as if SIGTERM
+                     arrived mid-step — drives the emergency-save path
+                     without depending on signal-delivery timing.
+
+Activation: programmatic (`activate(...)`), or a plan string from config
+(`ExperimentConfig.fault_plan`) / the MIDGPT_FAULTS env var, parsed by
+`activate_plan`: comma-separated `kind[@step][*times]`, e.g.
+`"nan_grad@12,ckpt_io_error*2"`. The supervisor activates the configured
+plan exactly once per supervised run — NOT once per restart attempt — so a
+consumed fault stays consumed across rollbacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import typing as tp
+
+KINDS = (
+    "nan_grad",
+    "ckpt_io_error",
+    "kill_mid_save",
+    "truncate_ckpt_item",
+    "preempt",
+)
+
+_PLAN_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$")
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    step: tp.Optional[int] = None  # fire only when the hook's step matches
+    times: int = 1  # remaining firings
+    fired: int = 0  # total firings so far
+
+
+_active: tp.List[Fault] = []
+
+
+def activate(kind: str, *, step: tp.Optional[int] = None, times: int = 1) -> Fault:
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; known: {KINDS}")
+    f = Fault(kind, step=step, times=times)
+    _active.append(f)
+    return f
+
+
+def activate_plan(plan: str) -> tp.List[Fault]:
+    """Parse and activate `kind[@step][*times]` comma-separated specs."""
+    out = []
+    for spec in filter(None, (s.strip() for s in plan.split(","))):
+        m = _PLAN_RE.match(spec)
+        if not m:
+            raise ValueError(
+                f"bad fault spec {spec!r} (want kind[@step][*times], e.g. "
+                "'nan_grad@12' or 'ckpt_io_error*2')"
+            )
+        out.append(
+            activate(
+                m.group("kind"),
+                step=int(m.group("step")) if m.group("step") else None,
+                times=int(m.group("times")) if m.group("times") else 1,
+            )
+        )
+    return out
+
+
+def clear() -> None:
+    _active.clear()
+
+
+def active() -> tp.List[Fault]:
+    return list(_active)
+
+
+def fired_counts() -> tp.Dict[str, int]:
+    out: tp.Dict[str, int] = {}
+    for f in _active:
+        out[f.kind] = out.get(f.kind, 0) + f.fired
+    return out
+
+
+def should_fire(kind: str, *, step: tp.Optional[int] = None) -> bool:
+    """Consume one firing of the first matching armed fault.
+
+    A step-scoped fault only fires when the hook reports that exact step; a
+    stepless fault fires on any matching hook call."""
+    for f in _active:
+        if f.kind != kind or f.times <= 0:
+            continue
+        if f.step is not None and step != f.step:
+            continue
+        f.times -= 1
+        f.fired += 1
+        return True
+    return False

@@ -1,0 +1,73 @@
+"""Preemption flag: SIGTERM/SIGINT -> emergency save at the next step boundary.
+
+A signal handler may run at any host-code point, so it only sets a flag; the
+training loop polls the flag at step boundaries (the only place a consistent
+save is possible) and performs one synchronous emergency checkpoint before
+exiting. On multihost meshes the poll goes through `any_host_requested`,
+which all-gathers the flag across processes so EVERY host takes the same
+save-and-exit branch — a host-local decision would deadlock the collective
+inside the next compiled step (half the hosts enter it, half don't).
+
+`install_handlers` chains: after the first signal fires, the previous
+handler is restored, so a second SIGINT still hard-kills a wedged run.
+"""
+
+from __future__ import annotations
+
+import signal
+import typing as tp
+
+import numpy as np
+
+_requested = False
+_previous: tp.Dict[int, tp.Any] = {}
+
+
+def request(signum: tp.Optional[int] = None, frame: tp.Any = None) -> None:
+    """Mark a preemption (the signal handler; also callable directly)."""
+    global _requested
+    _requested = True
+    if signum is not None and signum in _previous:
+        # One-shot: a second signal reaches the previous (default) handler.
+        signal.signal(signum, _previous.pop(signum))
+
+
+def requested() -> bool:
+    """Host-local flag (free; no collective)."""
+    return _requested
+
+
+def reset() -> None:
+    global _requested
+    _requested = False
+    for signum, prev in list(_previous.items()):
+        signal.signal(signum, prev)
+    _previous.clear()
+
+
+def install_handlers(
+    signums: tp.Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+) -> None:
+    """Route the preemption signals through `request` (launch.py calls this
+    before train; tests drive `request()`/the `preempt` fault directly)."""
+    for signum in signums:
+        prev = signal.signal(signum, request)
+        _previous.setdefault(signum, prev)
+
+
+def any_host_requested() -> bool:
+    """True when ANY host saw a preemption signal — replicated decision.
+
+    Single-process: the local flag, no device work. Multihost: one tiny
+    all-gather, which is why the train loop gates this behind
+    `preempt_check_interval`."""
+    import jax
+
+    if jax.process_count() == 1:
+        return _requested
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([_requested], dtype=np.int32)
+    )
+    return bool(np.asarray(flags).any())

@@ -1,0 +1,158 @@
+"""Run supervisor: restart-on-divergence with data-window skip.
+
+`supervise(config)` wraps `train(config)` in a bounded restart policy:
+
+  1. `train` raises DivergenceError when the sticky health carrier goes
+     non-finite (training/train.py). The poisoned batch lies in
+     `(last_good_step, step]` — stickiness guarantees nothing before the
+     last verified checkpoint can be bad.
+  2. The supervisor rolls back by simply re-entering `train`: resume picks
+     `latest_verified_step()` automatically. It advances
+     `config.data_step_offset` so the replayed iterations sample data PAST
+     the detected window (train threads `itr + data_step_offset` into the
+     positional sampler and the dropout key stream), exactly as if the
+     poisoned shard had been cut out of the stream — deterministically,
+     because the offset is plain config.
+  3. Attempts share one TrainRuntime, so the rollback path reuses the
+     already-compiled train step — zero recompiles per restart (pinned in
+     tests/test_robustness.py).
+  4. After `max_restarts` rollbacks (or a divergence with no verified
+     checkpoint to return to) it fails loudly with a diagnosis of every
+     skipped window, so an operator can tell data poisoning apart from an
+     optimization-level divergence (bad lr/warmup shifts with the data and
+     keeps recurring).
+
+The rollback ledger (current offset + skipped windows) is persisted to
+`rundir/supervisor_state.json`, so a supervisor relaunched after a
+preemption resumes with the same skips and the trajectory stays exactly
+reproducible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import typing as tp
+
+from midgpt_tpu.config import ExperimentConfig
+from midgpt_tpu.robustness import faults
+from midgpt_tpu.robustness.errors import DivergenceError
+from midgpt_tpu.training.train import TrainRuntime, make_runtime, train
+
+STATE_NAME = "supervisor_state.json"
+
+
+def _state_path(rundir: str) -> tp.Optional[str]:
+    if not rundir or rundir.startswith("gs://"):
+        return None
+    return os.path.join(rundir, STATE_NAME)
+
+
+def _load_state(rundir: str) -> tp.Dict[str, tp.Any]:
+    path = _state_path(rundir)
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _save_state(rundir: str, state: tp.Dict[str, tp.Any]) -> None:
+    path = _state_path(rundir)
+    if path is None:
+        return
+    os.makedirs(rundir, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh, indent=1)
+    os.replace(tmp, path)
+
+
+def supervise(
+    config: ExperimentConfig,
+    *,
+    runtime: tp.Optional[TrainRuntime] = None,
+    max_restarts: tp.Optional[int] = None,
+    backoff_sec: tp.Optional[float] = None,
+    sleep_fn: tp.Callable[[float], None] = time.sleep,
+) -> dict:
+    """Run `train(config)` under the restart policy (module docstring).
+
+    Returns train's result dict with a `"supervisor"` summary added.
+    `max_restarts`/`backoff_sec` default to the config knobs; `sleep_fn` is
+    injectable so tests don't pay real backoff."""
+    import jax  # deferred: keep module import JAX-free for tools
+
+    if max_restarts is None:
+        max_restarts = config.max_restarts
+    if backoff_sec is None:
+        backoff_sec = config.restart_backoff_sec
+    # Activate the fault plan ONCE per supervised run (not per attempt): a
+    # consumed fault must stay consumed across rollbacks, like the real
+    # failure it models.
+    plan = config.fault_plan or os.environ.get("MIDGPT_FAULTS", "")
+    if plan:
+        faults.activate_plan(plan)
+
+    persisted = _load_state(config.rundir)
+    offset = max(config.data_step_offset, int(persisted.get("data_step_offset", 0)))
+    windows: tp.List[tp.List[int]] = [
+        list(w) for w in persisted.get("windows_skipped", [])
+    ]
+    restarts = int(persisted.get("restarts", 0))
+    rt = runtime if runtime is not None else make_runtime(config)
+
+    while True:
+        cfg = (
+            config
+            if offset == config.data_step_offset
+            else config.replace(data_step_offset=offset)
+        )
+        try:
+            result = train(cfg, runtime=rt)
+            result["supervisor"] = {
+                "restarts": restarts,
+                "windows_skipped": windows,
+                "data_step_offset": offset,
+                "faults_fired": faults.fired_counts(),
+            }
+            return result
+        except DivergenceError as e:
+            if e.last_good_step is None:
+                raise RuntimeError(
+                    f"training diverged at step {e.step} with NO verified "
+                    "checkpoint to roll back to (divergence before the first "
+                    "save). Nothing to resume; fix learning_rate/warmup_steps "
+                    f"or the data and restart. Underlying: {e}"
+                ) from e
+            # Poisoned DATA window, in sampler (data-index) coordinates.
+            lo = e.last_good_step + 1 + offset
+            hi = e.step + offset
+            if restarts >= max_restarts:
+                raise RuntimeError(
+                    f"training diverged {restarts + 1} time(s); restart "
+                    f"budget ({max_restarts}) exhausted. Data windows "
+                    f"skipped so far: {windows}; the final divergence was "
+                    f"detected in data window [{lo}, {hi}]. Recurring "
+                    "divergence across DIFFERENT data windows points at the "
+                    "optimization (lower learning_rate / raise "
+                    "warmup_steps), not at one bad shard."
+                ) from e
+            windows.append([lo, hi])
+            restarts += 1
+            offset += max(1, e.step - e.last_good_step)
+            _save_state(
+                config.rundir,
+                {
+                    "data_step_offset": offset,
+                    "windows_skipped": windows,
+                    "restarts": restarts,
+                },
+            )
+            if jax.process_index() == 0:
+                print(
+                    f"supervisor: divergence at step {e.step}; rolling back "
+                    f"to verified step {e.last_good_step}, skipping data "
+                    f"window [{lo}, {hi}] (restart {restarts}/{max_restarts})"
+                )
+            sleep_fn(backoff_sec * (2 ** (restarts - 1)))

@@ -155,9 +155,12 @@ def restore_for_sampling(
         shardings,
     )
     mngr = CheckpointManager(ckpt_dir)
-    step = mngr.latest_step()
+    # Verified steps only (training/checkpoint.py manifests): never sample
+    # from a save truncated by a mid-save kill. Pre-manifest checkpoint
+    # dirs fall back to the plain latest step.
+    step = mngr.latest_verified_step()
     if step is None:
-        raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
+        raise FileNotFoundError(f"no verified checkpoint found under {ckpt_dir}")
     params = mngr.restore(step, {"params": abstract})["params"]
     return params, step
 

@@ -33,6 +33,16 @@ When the pool runs dry, the scheduler EVICTS the youngest running slot
 generated tokens folded into the prompt — recompute-style preemption), so
 the oldest requests always make progress and the engine never deadlocks.
 
+Robustness levers (each round starts with an expiry pass):
+
+  * **Per-request deadline/TTL** — `submit(..., ttl_s=...)`: a request that
+    is still queued or generating past its deadline is finished with
+    `status="timeout"` (partial tokens returned) and its pages freed, so a
+    stalled client cannot occupy pool pages forever.
+  * **Backpressure** — `max_backlog_pages` bounds the worst-case page
+    demand of all live requests; `submit` raises BackpressureError beyond
+    it instead of growing the queue (and the eviction churn) without bound.
+
 Greedy (temperature=0) serving is token-for-token identical to
 `engine.generate` on the same prompt (parity pin in tests/test_sampling.py);
 stochastic sampling draws from a different key stream (per-chunk splits per
@@ -134,12 +144,20 @@ class PageAllocator:
             self._free.append(p)
 
 
+class BackpressureError(RuntimeError):
+    """Admission would oversubscribe the page pool beyond the configured
+    backlog budget — the caller should shed load or retry later, instead of
+    the request sitting in an unbounded queue (or thrashing the pool with
+    evictions) indefinitely."""
+
+
 @dataclasses.dataclass
 class Request:
     uid: int
     prompt: np.ndarray  # (T0,) int32
     max_new_tokens: int
     eos_id: tp.Optional[int] = None
+    deadline: tp.Optional[float] = None  # absolute time.perf_counter() expiry
 
 
 @dataclasses.dataclass
@@ -166,6 +184,7 @@ class FinishedRequest:
     uid: int
     tokens: np.ndarray  # prompt + generated
     token_times: tp.List[float]  # wall-clock completion time per new token
+    status: str = "ok"  # "ok" | "timeout" (deadline expired before finish)
 
 
 class ServeEngine:
@@ -187,6 +206,7 @@ class ServeEngine:
         seed: int = 0,
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "auto",
+        max_backlog_pages: tp.Optional[int] = None,
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
         self.config = config
@@ -204,6 +224,10 @@ class ServeEngine:
             # (+ the sink) — the continuous-batching bet that Σ used-lengths
             # stays well under n_slots * block_size.
             num_pages = 1 + max_slots * self.max_pages_per_slot // 2
+        # Backpressure bound: worst-case page demand (prompt + full budget)
+        # summed over every live request, queued or running. None (default):
+        # admission is unbounded, the pre-TTL behavior.
+        self.max_backlog_pages = max_backlog_pages
         self.allocator = PageAllocator(num_pages)
         self.cache = PagedKVCache.init(
             config, num_pages=num_pages, page_size=page_size, dtype=cache_dtype
@@ -222,7 +246,13 @@ class ServeEngine:
         prompt: tp.Sequence[int],
         max_new_tokens: int,
         eos_id: tp.Optional[int] = None,
+        ttl_s: tp.Optional[float] = None,
     ) -> int:
+        """Queue a request. `ttl_s` bounds its total residence time: a
+        request still unfinished `ttl_s` seconds from now is evicted with a
+        `timeout` status instead of occupying queue slots / pool pages
+        forever. Raises BackpressureError when the engine's worst-case page
+        backlog (`max_backlog_pages`) is already committed."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         S = self.config.block_size
         if len(prompt) + max_new_tokens > S:
@@ -239,10 +269,34 @@ class ServeEngine:
                 f"request needs {need} pages but the pool only has "
                 f"{self.allocator.num_pages - 1} allocatable"
             )
+        if self.max_backlog_pages is not None:
+            backlog = self._backlog_pages()
+            if backlog + need > self.max_backlog_pages:
+                raise BackpressureError(
+                    f"admission refused: request needs {need} worst-case "
+                    f"pages on top of a committed backlog of {backlog} "
+                    f"(budget {self.max_backlog_pages}) — the pool is "
+                    "oversubscribed; shed load or retry after requests "
+                    "finish"
+                )
         uid = self._uid
         self._uid += 1
-        self.queue.append(Request(uid, prompt, max_new_tokens, eos_id))
+        deadline = None if ttl_s is None else time.perf_counter() + ttl_s
+        self.queue.append(Request(uid, prompt, max_new_tokens, eos_id, deadline))
         return uid
+
+    def _backlog_pages(self) -> int:
+        """Worst-case page demand committed to live (queued + running)
+        requests. Uses each request's FULL footprint — prompt plus the whole
+        generation budget — because that is what the pool must eventually
+        absorb if nothing times out early."""
+
+        def worst(req: Request) -> int:
+            return -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
+
+        queued = sum(worst(r) for r in self.queue)
+        running = sum(worst(s.request) for s in self.slots if s is not None)
+        return queued + running
 
     @property
     def idle(self) -> bool:
@@ -278,10 +332,49 @@ class ServeEngine:
     # -- scheduling round ----------------------------------------------
 
     def step(self) -> None:
-        """One round: admit -> one prefill chunk -> one decode chunk."""
+        """One round: expire -> admit -> one prefill chunk -> one decode
+        chunk."""
+        self._expire_round()
         self._admit()
         self._prefill_round()
         self._decode_round()
+
+    def _expire_round(self) -> None:
+        """Finish every deadline-expired request with a `timeout` status.
+
+        Expired QUEUED requests stop blocking FCFS admission; expired
+        RUNNING slots free their pages immediately — a stalled client
+        deadline must not hold pool pages hostage while younger requests
+        get evicted around it. Whatever tokens were generated before the
+        deadline are returned (partial result)."""
+        now = time.perf_counter()
+
+        def expired(req: Request) -> bool:
+            return req.deadline is not None and now > req.deadline
+
+        still_queued = []
+        for req in self.queue:
+            if expired(req):
+                self.finished[req.uid] = FinishedRequest(
+                    uid=req.uid, tokens=req.prompt, token_times=[],
+                    status="timeout",
+                )
+            else:
+                still_queued.append(req)
+        self.queue[:] = still_queued
+        for i, slot in enumerate(self.slots):
+            if slot is not None and expired(slot.request):
+                req = slot.request
+                self.finished[req.uid] = FinishedRequest(
+                    uid=req.uid,
+                    tokens=np.concatenate(
+                        [req.prompt, np.asarray(slot.generated, np.int32)]
+                    ),
+                    token_times=slot.token_times,
+                    status="timeout",
+                )
+                self.allocator.free(slot.pages)
+                self.slots[i] = None
 
     def _admit(self) -> None:
         for i, s in enumerate(self.slots):
@@ -330,6 +423,7 @@ class ServeEngine:
                 new_prompt,
                 req.max_new_tokens - len(victim.generated),
                 req.eos_id,
+                req.deadline,  # the clock keeps running across preemptions
             ),
         )
         self.allocator.free(victim.pages)

@@ -1,4 +1,4 @@
 from midgpt_tpu.training.optim import make_optimizer
-from midgpt_tpu.training.train import train, make_train_step
+from midgpt_tpu.training.train import make_runtime, make_train_step, train
 
-__all__ = ["make_optimizer", "train", "make_train_step"]
+__all__ = ["make_optimizer", "make_runtime", "train", "make_train_step"]

@@ -18,6 +18,7 @@ dropout off (reference train.py:99-117).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 import typing as tp
@@ -34,7 +35,9 @@ from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 from midgpt_tpu.parallel.data import make_global_batch
 from midgpt_tpu.parallel.fsdp import constrain, named_shardings
 from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
-from midgpt_tpu.training.checkpoint import CheckpointManager
+from midgpt_tpu.robustness import faults, preempt
+from midgpt_tpu.robustness.errors import DivergenceError
+from midgpt_tpu.training.checkpoint import CheckpointManager, _abstract_like
 from midgpt_tpu.training.metrics import MetricLogger, Profiler, Progress, mfu
 from midgpt_tpu.training.optim import make_optimizer, make_schedule
 
@@ -368,59 +371,136 @@ def evaluate(
     return float(total) / n
 
 
-def train(config: ExperimentConfig) -> dict:
-    """Run the experiment; returns final metrics (for tests/benches)."""
+def _all_finite(tree) -> Array:
+    """Device-side finiteness sweep over every floating leaf of `tree`."""
+    return jnp.all(
+        jnp.array(
+            [
+                jnp.all(jnp.isfinite(l))
+                for l in jax.tree.leaves(tree)
+                if jnp.issubdtype(l.dtype, jnp.floating)
+            ]
+        )
+    )
+
+
+@dataclasses.dataclass
+class TrainRuntime:
+    """Everything about a run that survives a restart attempt.
+
+    The supervisor's rollback (robustness/supervisor.py) re-enters `train`
+    after restoring a checkpoint; rebuilding the jitted step there would
+    recompile the entire program per attempt (minutes at scale — and pinned
+    against by tests/test_robustness.py with the test_recompile_pins.py
+    methodology). A TrainRuntime carries the mesh, dataset, and every jitted
+    callable across attempts; only host-side config fields (e.g.
+    `data_step_offset`) may differ between the attempts that share one.
+    """
+
+    mesh: tp.Any
+    dataset: TokenDataset
+    optimizer: tp.Any
+    schedule: tp.Callable
+    param_specs: tp.Any
+    step: tp.Callable
+    eval_loss: tp.Callable
+    eval_loss_many: tp.Callable
+    # Abstract {"params", "opt_state"} with shardings — the restore template,
+    # so a rollback attempt never needs live donated buffers from a previous
+    # attempt.
+    abstract_state: tp.Dict[str, tp.Any]
+    finite_check: tp.Callable
+    n_params: int
+    _initial: tp.Optional[tp.Tuple[tp.Any, tp.Any]] = None
+
+    def take_initial(self, config: ExperimentConfig) -> tp.Tuple[tp.Any, tp.Any]:
+        """Hand out the freshly initialized state (once); re-init if a later
+        attempt starts from scratch (the first attempt donated the buffers)."""
+        if self._initial is None:
+            params, opt_state, _, _ = init_state(config, self.mesh)
+            return params, opt_state
+        state, self._initial = self._initial, None
+        return state
+
+
+def make_runtime(config: ExperimentConfig) -> TrainRuntime:
+    """Build the mesh/dataset/compiled-step bundle `train` runs on."""
     mesh = make_mesh(config.mesh)
     n_proc = jax.process_count()
     assert config.batch_size % n_proc == 0, "global batch must divide process count"
-    local_bs = config.batch_size // n_proc
-
     dataset = TokenDataset(
         config.data_dir, seed=config.data_seed, shard_by_process=n_proc > 1
     )
-
     params, opt_state, param_specs, optimizer = init_state(config, mesh)
     schedule = make_schedule(config)
-    step, eval_loss, eval_loss_many = make_train_step(config, optimizer, mesh, param_specs)
-    n_params = GPT.count_params(params)
+    step, eval_loss, eval_loss_many = make_train_step(
+        config, optimizer, mesh, param_specs
+    )
+    return TrainRuntime(
+        mesh=mesh,
+        dataset=dataset,
+        optimizer=optimizer,
+        schedule=schedule,
+        param_specs=param_specs,
+        step=step,
+        eval_loss=eval_loss,
+        eval_loss_many=eval_loss_many,
+        abstract_state={
+            "params": _abstract_like(params),
+            "opt_state": _abstract_like(opt_state),
+        },
+        finite_check=jax.jit(_all_finite),
+        n_params=GPT.count_params(params),
+        _initial=(params, opt_state),
+    )
+
+
+def train(
+    config: ExperimentConfig, *, runtime: tp.Optional[TrainRuntime] = None
+) -> dict:
+    """Run the experiment; returns final metrics (for tests/benches).
+
+    `runtime` lets a supervisor re-enter after a rollback without
+    recompiling anything (TrainRuntime docstring). Resume picks the newest
+    *verified* checkpoint (training/checkpoint.py manifests), so a save
+    truncated by a preemption is skipped, not restored."""
+    rt = runtime if runtime is not None else make_runtime(config)
+    mesh, dataset, schedule = rt.mesh, rt.dataset, rt.schedule
+    step, eval_loss_many = rt.step, rt.eval_loss_many
+    local_bs = config.batch_size // jax.process_count()
     if jax.process_index() == 0:
-        print(f"Model has {n_params:,} parameters.")
+        print(f"Model has {rt.n_params:,} parameters.")
 
     mngr = None
     first_step = 0
+    params = opt_state = None
     if not config.debug and config.rundir:
         mngr = CheckpointManager(
             config.rundir,
-            max_to_keep=1,
+            max_to_keep=config.ckpt_max_to_keep,
             save_interval_steps=config.eval_interval,
+            write_retries=config.ckpt_write_retries,
+            retry_backoff_sec=config.ckpt_retry_backoff_sec,
         )
-        if mngr.latest_step() is not None:
-            state = mngr.restore(
-                mngr.latest_step(), {"params": params, "opt_state": opt_state}
-            )
+        resume_step = mngr.latest_verified_step()
+        if resume_step is not None:
+            state = mngr.restore(resume_step, rt.abstract_state)
             params, opt_state = state["params"], state["opt_state"]
-            first_step = mngr.latest_step() + 1
+            first_step = resume_step + 1
             # Base case of the per-step health induction (the in-step check
             # watches grads, which cannot see a corrupted RESTORED state):
             # one device-side finiteness sweep of params + opt_state at
-            # resume, one sync, never again.
-            restored_ok = jax.jit(
-                lambda t: jnp.all(
-                    jnp.array(
-                        [
-                            jnp.all(jnp.isfinite(l))
-                            for l in jax.tree.leaves(t)
-                            if jnp.issubdtype(l.dtype, jnp.floating)
-                        ]
-                    )
-                )
-            )((params, opt_state))
-            if not bool(restored_ok):
+            # resume, one sync, never again. The manifest guards the bytes;
+            # this guards the VALUES (a v2->v3 migration bug, a save of
+            # NaN state by older code).
+            if not bool(rt.finite_check((params, opt_state))):
                 raise FloatingPointError(
-                    f"checkpoint step {mngr.latest_step()} in {config.rundir} "
+                    f"checkpoint step {resume_step} in {config.rundir} "
                     "restored non-finite values — it is corrupt; do not "
                     "resume from it."
                 )
+    if params is None:
+        params, opt_state = rt.take_initial(config)
 
     logger = MetricLogger(config)
     profiler = Profiler(config.rundir, enabled=config.debug)
@@ -433,9 +513,13 @@ def train(config: ExperimentConfig) -> dict:
         except Exception as e:  # diagnostic only — never block training
             print(f"visualize_array_sharding unavailable: {e}")
     data_sp = batch_spec(with_accum=True, shard_seq=mesh.shape["sp"] > 1)
-    # Positional key stream: fold the step index into the base key so resumed
-    # runs continue the exact dropout-key sequence (the data sampler is
-    # already positional; this makes the whole step a function of `itr`).
+    # Positional key stream: fold the DATA step index into the base key so
+    # resumed runs continue the exact dropout-key sequence (the data sampler
+    # is already positional; this makes the whole step a function of the
+    # data index). `data_step_offset` shifts both streams together: after a
+    # divergence rollback the supervisor advances it so the replayed steps
+    # sample PAST the poisoned window — deterministically, since the offset
+    # is plain config.
     base_key = jax.random.PRNGKey(config.seed)
     T = config.model_config.block_size
     metrics: tp.Dict[str, float] = {}
@@ -449,126 +533,179 @@ def train(config: ExperimentConfig) -> dict:
     # jnp.zeros here gives iteration 1 a different input-sharding aval than
     # every later iteration, silently compiling the whole step TWICE (found
     # by the pass-2 compile counter; pinned in tests/test_recompile_pins.py).
-    loss = jax.device_put(
-        jnp.zeros((), jnp.float32),
-        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
-    )
+    replicated = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    loss = jax.device_put(jnp.zeros((), jnp.float32), replicated)
     from midgpt_tpu.analysis.hlo_audit import jit_cache_size
 
     step_cache_size = functools.partial(jit_cache_size, step)
     warned_recompile = False
-    for itr in range(first_step, config.max_steps):
-        if itr % config.eval_interval == 0:
-            metrics["loss/train"] = evaluate(
-                config, eval_loss_many, params, dataset, "train", mesh, itr
-            )
-            metrics["loss/val"] = evaluate(
-                config, eval_loss_many, params, dataset, "val", mesh, itr
-            )
-            logger.log(itr, {k: metrics[k] for k in ("loss/train", "loss/val")})
-            t_last, tokens_since = _time.time(), 0  # eval pauses don't count
-
-        x, y = dataset.batch("train", itr, T, local_bs, config.g_accum_iters)
-        xg = make_global_batch(x, mesh, data_sp)
-        yg = make_global_batch(y, mesh, data_sp)
-        step_key = jax.random.fold_in(base_key, itr)
-        profiler.maybe_start(itr, at_step=first_step + 1)
-        params, opt_state, loss = step(params, opt_state, xg, yg, step_key, loss)
-        profiler.maybe_stop(wait_for=loss)
-
-        tokens_since += config.batch_size * config.g_accum_iters * T
-        if itr % config.log_interval == 0:
-            loss_f = float(loss)
-            if not np.isfinite(loss_f):
-                # Divergence guard (no reference counterpart — its NaN runs
-                # burn wall-clock until someone looks at wandb): stop loudly
-                # at the already-paid log sync, WITHOUT saving the poisoned
-                # params over the rolling checkpoint, and say where the last
-                # good state is.
-                last_good = None
-                if mngr is not None:
-                    mngr.wait()
-                    last_good = mngr.latest_step()
-                raise FloatingPointError(
-                    f"non-finite loss ({loss_f}) at step {itr} — training "
-                    "has diverged. Last good checkpoint: "
-                    + (f"step {last_good} in {config.rundir}" if last_good is not None
-                       else "none was saved")
-                    + ". Lower learning_rate or raise warmup_steps and resume."
+    preempted = False
+    try:
+        for itr in range(first_step, config.max_steps):
+            if itr % config.eval_interval == 0:
+                metrics["loss/train"] = evaluate(
+                    config, eval_loss_many, params, dataset, "train", mesh, itr
                 )
-            dt = _time.time() - t_last
-            tok_s = tokens_since / dt if dt > 0 else 0.0
-            t_last, tokens_since = _time.time(), 0
-            # Recompile watch (graftcheck pass-2 hook): the whole step is ONE
-            # XLA program, so its jit cache must stay at exactly one entry.
-            # Growth means some input's shape/dtype is unstable across steps
-            # — the silent per-step-recompile failure mode CLAUDE.md warns
-            # about, easily >10x wall-clock, invisible in the loss. Warn at
-            # the already-paid log sync; pinned in tests/test_recompile_pins.py.
-            n_programs = step_cache_size()
-            if n_programs is not None and n_programs > 1 and not warned_recompile:
-                warned_recompile = True
+                metrics["loss/val"] = evaluate(
+                    config, eval_loss_many, params, dataset, "val", mesh, itr
+                )
+                logger.log(itr, {k: metrics[k] for k in ("loss/train", "loss/val")})
+                t_last, tokens_since = _time.time(), 0  # eval pauses don't count
+
+            data_itr = itr + config.data_step_offset
+            x, y = dataset.batch("train", data_itr, T, local_bs, config.g_accum_iters)
+            xg = make_global_batch(x, mesh, data_sp)
+            yg = make_global_batch(y, mesh, data_sp)
+            step_key = jax.random.fold_in(base_key, data_itr)
+            profiler.maybe_start(itr, at_step=first_step + 1)
+            params, opt_state, loss = step(params, opt_state, xg, yg, step_key, loss)
+            profiler.maybe_stop(wait_for=loss)
+
+            if faults.should_fire("nan_grad", step=data_itr):
+                # Poison the sticky carrier exactly as a NaN gradient would
+                # (health_flag folds grad badness into the reported loss).
+                # Same committed replicated aval as the real carrier, so the
+                # injection cannot recompile the step.
+                loss = jax.device_put(jnp.full((), jnp.nan, jnp.float32), replicated)
+            if faults.should_fire("preempt", step=data_itr):
+                preempt.request()
+
+            tokens_since += config.batch_size * config.g_accum_iters * T
+            if itr % config.log_interval == 0:
+                loss_f = float(loss)
+                if not np.isfinite(loss_f):
+                    # Divergence guard (no reference counterpart — its NaN
+                    # runs burn wall-clock until someone looks at wandb):
+                    # stop loudly at the already-paid log sync, WITHOUT
+                    # saving the poisoned params over the rolling
+                    # checkpoint, and say where the last good state is. The
+                    # supervisor catches this, rolls back, and skips the
+                    # window (robustness/supervisor.py).
+                    last_good = (
+                        mngr.latest_verified_step() if mngr is not None else None
+                    )
+                    raise DivergenceError(
+                        f"non-finite loss ({loss_f}) at step {itr} — training "
+                        "has diverged. Last good checkpoint: "
+                        + (f"step {last_good} in {config.rundir}"
+                           if last_good is not None else "none was saved")
+                        + ". Lower learning_rate or raise warmup_steps and "
+                        "resume.",
+                        step=itr,
+                        last_good_step=last_good,
+                        rundir=config.rundir,
+                    )
+                dt = _time.time() - t_last
+                tok_s = tokens_since / dt if dt > 0 else 0.0
+                t_last, tokens_since = _time.time(), 0
+                # Recompile watch (graftcheck pass-2 hook): the whole step is
+                # ONE XLA program, so its jit cache must stay at exactly one
+                # entry. Growth means some input's shape/dtype is unstable
+                # across steps — the silent per-step-recompile failure mode
+                # CLAUDE.md warns about, easily >10x wall-clock, invisible in
+                # the loss. Warn at the already-paid log sync; pinned in
+                # tests/test_recompile_pins.py.
+                n_programs = step_cache_size()
+                if n_programs is not None and n_programs > 1 and not warned_recompile:
+                    warned_recompile = True
+                    if jax.process_index() == 0:
+                        print(
+                            f"WARNING: train step has compiled {n_programs} distinct "
+                            "programs — input shapes/dtypes are unstable across "
+                            "steps and every recompile stalls the device "
+                            "(run graftcheck --audit / check batch shapes)"
+                        )
+                metrics.update(
+                    {
+                        "loss/optimized": loss_f,
+                        "lr": float(schedule(itr)),
+                        "throughput/tokens_per_sec": tok_s,
+                    }
+                )
+                m = mfu(tok_s, config.model_config, jax.device_count())
+                if m is not None:
+                    metrics["throughput/mfu"] = m
+                logger.log(itr, dict(metrics))
+                if progress.active:
+                    progress.update(
+                        0, loss=f"{loss_f:.4f}", lr=f"{metrics['lr']:.2e}",
+                        tok_s=f"{tok_s:,.0f}",
+                    )
+                elif jax.process_index() == 0:
+                    print(
+                        f"step {itr}: loss {loss_f:.4f} lr {metrics['lr']:.2e} "
+                        f"tok/s {tok_s:,.0f}"
+                    )
+            progress.update(1)
+            if mngr is not None and mngr.should_save(itr):
+                # One device sync per SAVE interval (not per step): never let
+                # a poisoned state overwrite the rolling checkpoints.
+                if not np.isfinite(float(loss)):
+                    last_good = mngr.latest_verified_step()
+                    raise DivergenceError(
+                        f"non-finite training state at step {itr} — refusing "
+                        "to overwrite the rolling checkpoint. Last good "
+                        f"checkpoint: step {last_good} in {config.rundir}. "
+                        "Lower learning_rate or raise warmup_steps and resume.",
+                        step=itr,
+                        last_good_step=last_good,
+                        rundir=config.rundir,
+                    )
+                mngr.save(itr, {"params": params, "opt_state": opt_state})
+            if itr % config.preempt_check_interval == 0 and preempt.any_host_requested():
+                # Preemption (SIGTERM/SIGINT or the `preempt` fault): one
+                # SYNCHRONOUS emergency save at this step boundary, then a
+                # clean exit. The flag is replicated across hosts
+                # (robustness/preempt.py), so every host takes this branch
+                # at the same itr — no host-divergent control flow around
+                # the collectives inside `step`.
+                if (
+                    mngr is not None
+                    and mngr.latest_step() != itr  # interval save just landed?
+                    and np.isfinite(float(loss))  # never persist poisoned state
+                ):
+                    mngr.save(itr, {"params": params, "opt_state": opt_state},
+                              force=True)
+                    mngr.wait()  # barrier + manifest: verified before we exit
+                metrics["preempted"] = True
+                preempted = True
                 if jax.process_index() == 0:
                     print(
-                        f"WARNING: train step has compiled {n_programs} distinct "
-                        "programs — input shapes/dtypes are unstable across "
-                        "steps and every recompile stalls the device "
-                        "(run graftcheck --audit / check batch shapes)"
+                        f"preemption: emergency checkpoint at step {itr} in "
+                        f"{config.rundir or '(no rundir)'}; exiting"
                     )
-            metrics.update(
-                {
-                    "loss/optimized": loss_f,
-                    "lr": float(schedule(itr)),
-                    "throughput/tokens_per_sec": tok_s,
-                }
-            )
-            m = mfu(tok_s, config.model_config, jax.device_count())
-            if m is not None:
-                metrics["throughput/mfu"] = m
-            logger.log(itr, dict(metrics))
-            if progress.active:
-                progress.update(
-                    0, loss=f"{loss_f:.4f}", lr=f"{metrics['lr']:.2e}",
-                    tok_s=f"{tok_s:,.0f}",
-                )
-            elif jax.process_index() == 0:
-                print(
-                    f"step {itr}: loss {loss_f:.4f} lr {metrics['lr']:.2e} "
-                    f"tok/s {tok_s:,.0f}"
-                )
-        progress.update(1)
-        if mngr is not None and mngr.should_save(itr):
-            # One device sync per SAVE interval (not per step): never let a
-            # poisoned state overwrite the max_to_keep=1 rolling checkpoint.
-            if not np.isfinite(float(loss)):
-                mngr.wait()
-                raise FloatingPointError(
-                    f"non-finite training state at step {itr} — refusing to "
-                    "overwrite the rolling checkpoint. Last good checkpoint: "
-                    f"step {mngr.latest_step()} in {config.rundir}. Lower "
-                    "learning_rate or raise warmup_steps and resume."
-                )
-            mngr.save(itr, {"params": params, "opt_state": opt_state})
+                break
 
-    progress.close()
-    metrics["loss/final"] = float(
-        evaluate(config, eval_loss_many, params, dataset, "val", mesh, config.max_steps)
-    )
-    logger.log(config.max_steps, {"loss/val_final": metrics["loss/final"]})
-    logger.close()
-    if mngr is not None:
-        # Force-persist the final state unless the in-loop save already did
-        # (orbax raises StepAlreadyExists on a forced duplicate).
-        mngr.wait()
-        # Gate on the sticky loss too: a transient mid-run poisoning that
-        # left NaN only in optimizer state would pass the val-loss check.
-        if mngr.latest_step() != config.max_steps - 1 and np.isfinite(
-            metrics["loss/final"]
-        ) and np.isfinite(float(loss)):
-            mngr.save(
-                config.max_steps - 1,
-                {"params": params, "opt_state": opt_state},
-                force=True,
+        if not preempted:
+            metrics["loss/final"] = float(
+                evaluate(
+                    config, eval_loss_many, params, dataset, "val", mesh,
+                    config.max_steps,
+                )
             )
-        mngr.close()
+            logger.log(config.max_steps, {"loss/val_final": metrics["loss/final"]})
+            if mngr is not None:
+                # Force-persist the final state unless the in-loop save
+                # already did (orbax raises StepAlreadyExists on a forced
+                # duplicate).
+                mngr.wait()
+                # Gate on the sticky loss too: a transient mid-run poisoning
+                # that left NaN only in optimizer state would pass the
+                # val-loss check.
+                if mngr.latest_step() != config.max_steps - 1 and np.isfinite(
+                    metrics["loss/final"]
+                ) and np.isfinite(float(loss)):
+                    mngr.save(
+                        config.max_steps - 1,
+                        {"params": params, "opt_state": opt_state},
+                        force=True,
+                    )
+    finally:
+        # Never abandon an in-flight async save: a raised divergence guard
+        # (or any other exception) must not leave a half-written TensorStore
+        # step behind — close() barriers, manifests, and GCs.
+        progress.close()
+        logger.close()
+        if mngr is not None:
+            mngr.close()
     return {"params": params, "opt_state": opt_state, "metrics": metrics}

@@ -84,6 +84,16 @@ def attn(q):
 """,
         1,
     ),
+    "GC007": (
+        """\
+def flush(mngr, state):
+    try:
+        mngr.save(0, state)
+    except Exception:
+        pass
+""",
+        4,
+    ),
 }
 
 

@@ -1,0 +1,434 @@
+"""Fault-tolerant training, end to end on the virtual CPU mesh: supervisor
+rollback with data-window skip, verified checkpointing (manifests, retry,
+verified-only GC), preemption emergency saves, exact-continuation resume,
+and one pin per fault in the robustness/faults.py registry.
+
+Compile discipline: every train() in this module shares ONE module-scoped
+TrainRuntime, which is both the wall-clock lever (one step compile for the
+whole file) and the acceptance pin — the supervisor's rollback/resume path
+must reuse the compiled train step (test_recompile_pins.py methodology).
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.analysis.hlo_audit import jit_cache_size
+from midgpt_tpu.config import ExperimentConfig, MeshConfig
+from midgpt_tpu.models.gpt import GPTConfig
+from midgpt_tpu.robustness import faults, preempt
+from midgpt_tpu.robustness.errors import (
+    CheckpointCorruptError,
+    CheckpointWriteError,
+    DivergenceError,
+    SimulatedPreemption,
+)
+from midgpt_tpu.robustness.supervisor import supervise
+from midgpt_tpu.training.checkpoint import MANIFEST_NAME, CheckpointManager
+from midgpt_tpu.training.train import make_runtime, train
+
+CFG = GPTConfig(block_size=32, vocab_size=64, n_layer=1, n_head=2, n_embd=32)
+
+
+def base_config(data_dir, **overrides) -> ExperimentConfig:
+    base = dict(
+        rundir="",
+        data_dir=str(data_dir),
+        learning_rate=1e-2,
+        batch_size=8,
+        warmup_steps=5,
+        min_lr=1e-3,
+        lr_decay_steps=60,
+        max_steps=16,
+        beta2=0.99,
+        weight_decay=1e-4,
+        eval_interval=8,
+        param_dtype="float32",
+        compute_dtype="float32",
+        g_accum_iters=1,
+        shard_model=True,
+        mesh=MeshConfig(data=2, fsdp=4, sp=1),
+        eval_steps=2,
+        log_interval=1,
+        fsdp_min_size=0,
+        model_config=CFG,
+        restart_backoff_sec=0.0,
+        ckpt_retry_backoff_sec=0.0,
+    )
+    base.update(overrides)
+    return ExperimentConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    preempt.reset()
+    yield
+    faults.clear()
+    preempt.reset()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    stream = (np.arange(20000) % 17).astype(np.uint16)
+    stream.tofile(d / "train.bin")
+    stream[:4000].tofile(d / "val.bin")
+    return d
+
+
+@pytest.fixture(scope="module")
+def runtime(data_dir):
+    """ONE compiled runtime for every train() in this module — rundir,
+    max_steps, fault_plan, and data_step_offset are host-side and may vary
+    per test (training/train.py TrainRuntime)."""
+    return make_runtime(base_config(data_dir))
+
+
+@pytest.fixture(scope="module")
+def straight16(data_dir, runtime, tmp_path_factory):
+    """The uninterrupted 16-step trajectory every resume test compares to."""
+    rundir = tmp_path_factory.mktemp("straight")
+    result = train(base_config(data_dir, rundir=str(rundir)), runtime=runtime)
+    return result, str(rundir)
+
+
+def _logged_losses(rundir) -> dict:
+    """step -> loss/optimized from a run's metrics.jsonl."""
+    out = {}
+    with open(os.path.join(rundir, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "loss/optimized" in rec:
+                out[rec["step"]] = rec["loss/optimized"]
+    return out
+
+
+# ----------------------------------------------------------------------
+# fault registry
+# ----------------------------------------------------------------------
+
+
+def test_fault_registry_semantics():
+    faults.activate_plan("nan_grad@12,ckpt_io_error*2")
+    assert not faults.should_fire("nan_grad", step=11)  # wrong step
+    assert faults.should_fire("nan_grad", step=12)
+    assert not faults.should_fire("nan_grad", step=12)  # consumed
+    assert faults.should_fire("ckpt_io_error")
+    assert faults.should_fire("ckpt_io_error")
+    assert not faults.should_fire("ckpt_io_error")  # times=2 exhausted
+    assert faults.fired_counts() == {"nan_grad": 1, "ckpt_io_error": 2}
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.activate("reboot")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        faults.activate_plan("nan_grad@@3")
+
+
+def test_stepless_hook_does_not_fire_step_scoped_fault():
+    faults.activate("ckpt_io_error", step=5)
+    assert not faults.should_fire("ckpt_io_error")  # scoped fault, stepless hook
+    assert faults.should_fire("ckpt_io_error", step=5)
+
+
+# ----------------------------------------------------------------------
+# verified checkpointing (numpy trees: no model in the loop)
+# ----------------------------------------------------------------------
+
+
+def _np_state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {"w": rng.normal(size=(16, 8)).astype(np.float32)},
+        "opt_state": {"mu": rng.normal(size=(16, 8)).astype(np.float32)},
+    }
+
+
+def _like(state):
+    return {
+        k: {n: jax.ShapeDtypeStruct(a.shape, a.dtype) for n, a in v.items()}
+        for k, v in state.items()
+    }
+
+
+def test_manifest_written_and_verified(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    state = _np_state()
+    mngr.save(3, state)
+    mngr.wait()
+    step_dir = mngr._step_dir(3)
+    assert step_dir is not None and os.path.exists(
+        os.path.join(step_dir, MANIFEST_NAME)
+    )
+    assert mngr.is_verified(3) and mngr.latest_verified_step() == 3
+    restored = mngr.restore(3, _like(state))
+    np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+    mngr.close()
+
+
+def test_corrupted_item_fails_verification_and_restore(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    state = _np_state()
+    mngr.save(0, state)
+    mngr.wait()
+    # flip bytes in the largest file under the step dir
+    files = []
+    for root, _, names in os.walk(tmp_path / "0"):
+        files += [os.path.join(root, n) for n in names if n != MANIFEST_NAME]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "rb+") as fh:
+        fh.truncate(max(1, os.path.getsize(victim) // 2))
+    problems = mngr.verify(0)
+    assert problems and any("truncated" in p or "mismatch" in p for p in problems)
+    assert mngr.latest_verified_step() is None  # manifests exist, none verify
+    with pytest.raises(CheckpointCorruptError, match="integrity"):
+        mngr.restore(0, _like(state))
+    mngr.close()
+
+
+def test_ckpt_io_error_retry_succeeds(tmp_path):
+    """Acceptance (c): transient write IOError -> retry succeeds and the
+    manifest verifies."""
+    faults.activate("ckpt_io_error", times=2)
+    mngr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, write_retries=3,
+        retry_backoff_sec=0.0,
+    )
+    assert mngr.save(0, _np_state()) is True
+    mngr.wait()
+    assert faults.fired_counts()["ckpt_io_error"] == 2
+    assert mngr.is_verified(0)
+    mngr.close()
+
+
+def test_ckpt_io_error_exhausts_budget(tmp_path):
+    faults.activate("ckpt_io_error", times=3)
+    mngr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, write_retries=3,
+        retry_backoff_sec=0.0,
+    )
+    with pytest.raises(CheckpointWriteError, match="3 attempt"):
+        mngr.save(0, _np_state())
+    mngr.close()
+
+
+def test_kill_mid_save_previous_verified_survives(tmp_path):
+    """Acceptance (b): a save killed between the TensorStore write and the
+    manifest commit leaves the PREVIOUS verified checkpoint as the resume
+    point; the half-written step is skipped, and a later save may reuse its
+    step number."""
+    mngr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    state = _np_state()
+    mngr.save(1, state)
+    mngr.wait()
+    faults.activate("kill_mid_save", step=2)
+    with pytest.raises(SimulatedPreemption):
+        mngr.save(2, _np_state(seed=1))
+    mngr.close()
+
+    resumed = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    assert resumed.latest_verified_step() == 1
+    restored = resumed.restore(1, _like(state))
+    np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+    # the crashed step's leftovers must not block re-saving step 2 (the
+    # manager clears the unverified remnant; force bypasses orbax's
+    # step-already-known interval filter)
+    resumed.save(2, _np_state(seed=2), force=True)
+    resumed.wait()
+    assert resumed.latest_verified_step() == 2
+    resumed.close()
+
+
+def test_truncate_after_manifest_detected(tmp_path):
+    """Bit-rot fault: corruption AFTER the manifest committed is caught by
+    re-verification at resume time."""
+    mngr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    mngr.save(1, _np_state())
+    mngr.wait()
+    faults.activate("truncate_ckpt_item", step=2)
+    mngr.save(2, _np_state(seed=1))
+    mngr.wait()  # finalize writes the manifest, THEN the fault truncates
+    assert mngr.verify(2)  # problems found
+    assert mngr.latest_verified_step() == 1
+    mngr.close()
+
+
+def test_gc_only_after_newer_verifies(tmp_path):
+    """max_to_keep=2 with verified-only GC: old steps are deleted only once
+    two newer VERIFIED steps exist; an unverified newest save triggers no
+    GC at all."""
+    mngr = CheckpointManager(str(tmp_path), save_interval_steps=1, max_to_keep=2)
+    for s in range(3):
+        mngr.save(s, _np_state(seed=s))
+    mngr.wait()
+    assert mngr.all_steps() == [1, 2]  # 0 GC'd after 2 verified
+    faults.activate("truncate_ckpt_item", step=3)
+    mngr.save(3, _np_state(seed=3))
+    mngr.wait()
+    # 3 is unverified: nothing new was GC'd, and resume still points at 2.
+    assert set(mngr.all_steps()) >= {1, 2, 3}
+    assert mngr.latest_verified_step() == 2
+    mngr.close()
+
+
+def test_restore_diagnostics(tmp_path):
+    """Satellite: missing step lists available steps; a v2 marker mismatch
+    names found vs expected and points at the migration tool."""
+    from midgpt_tpu.training import checkpoint as ckpt_mod
+
+    mngr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    state = _np_state()
+    mngr.save(4, state)
+    mngr.wait()
+    with pytest.raises(ValueError, match=r"available\s+steps: \[4\]"):
+        mngr.restore(9, _like(state))
+    mngr.close()
+
+    v2 = {"version": 2, "qkv_layout": "head_major"}
+    src = tmp_path / "v2"
+    orig = ckpt_mod.FORMAT
+    ckpt_mod.FORMAT = v2
+    try:
+        w = CheckpointManager(str(src), save_interval_steps=1)
+        w.save(0, state)
+        w.close()
+    finally:
+        ckpt_mod.FORMAT = orig
+    r = CheckpointManager(str(src), save_interval_steps=1)
+    with pytest.raises(ValueError) as ei:
+        r.restore(0, _like(state))
+    msg = str(ei.value)
+    assert "format" in msg and "'version': 2" in msg and "'version': 3" in msg
+    assert "migrate_ckpt_v2_v3" in msg
+    r.close()
+
+
+# ----------------------------------------------------------------------
+# supervisor: rollback, skip, budget — and the recompile pin
+# ----------------------------------------------------------------------
+
+
+def test_supervisor_nan_rollback_completes(data_dir, runtime, tmp_path):
+    """Acceptance (a): injected NaN at data step 13 -> rollback to the last
+    verified checkpoint (step 8), the window is skipped, the run completes
+    with finite loss — and the rollback/resume path reuses the compiled
+    train step (zero growth of its jit cache)."""
+    cfg = base_config(
+        data_dir, rundir=str(tmp_path), max_steps=16, fault_plan="nan_grad@13",
+    )
+    result = supervise(cfg, runtime=runtime)
+    sup = result["supervisor"]
+    assert sup["restarts"] == 1
+    assert sup["windows_skipped"] == [[9, 13]]
+    assert sup["faults_fired"] == {"nan_grad": 1}
+    assert np.isfinite(result["metrics"]["loss/final"])
+    # Recompile pin (test_recompile_pins.py methodology): every train() in
+    # this module — including this rollback + resume — shares one runtime,
+    # so its step must have compiled exactly ONE program, ever.
+    assert jit_cache_size(runtime.step) == 1
+    # rollback ledger persisted for cross-process relaunches
+    ledger = json.load(open(os.path.join(str(tmp_path), "supervisor_state.json")))
+    assert ledger["data_step_offset"] == sup["data_step_offset"] > 0
+
+
+def test_supervisor_budget_exhaustion_diagnosis(data_dir, runtime, tmp_path):
+    cfg = base_config(
+        data_dir, rundir=str(tmp_path), fault_plan="nan_grad@13",
+        max_restarts=0,
+    )
+    with pytest.raises(RuntimeError, match="budget"):
+        supervise(cfg, runtime=runtime)
+
+
+def test_supervisor_no_checkpoint_fails_loudly(data_dir, runtime):
+    """Divergence with nothing saved (no rundir): nothing to roll back to."""
+    cfg = base_config(data_dir, rundir="", fault_plan="nan_grad@3", debug=False)
+    with pytest.raises(RuntimeError, match="NO verified checkpoint"):
+        supervise(cfg, runtime=runtime)
+
+
+def test_divergence_error_carries_structure(data_dir, runtime, tmp_path):
+    cfg = base_config(data_dir, rundir=str(tmp_path), fault_plan="nan_grad@10")
+    faults.activate_plan(cfg.fault_plan)
+    with pytest.raises(DivergenceError) as ei:
+        train(cfg, runtime=runtime)
+    e = ei.value
+    assert e.step == 10 and e.last_good_step == 8 and e.rundir == str(tmp_path)
+    assert isinstance(e, FloatingPointError)  # legacy guard contract
+
+
+# ----------------------------------------------------------------------
+# exact continuation + preemption
+# ----------------------------------------------------------------------
+
+
+def test_exact_continuation_resume(data_dir, runtime, straight16, tmp_path):
+    """Satellite: train 2N straight vs train N, kill, resume to 2N — the
+    loss trajectories and final eval match (stateless positional sampler +
+    step-folded keys + exact checkpoint round-trip)."""
+    straight, straight_dir = straight16
+    rundir = str(tmp_path)
+    train(base_config(data_dir, rundir=rundir, max_steps=8), runtime=runtime)
+    resumed = train(base_config(data_dir, rundir=rundir, max_steps=16), runtime=runtime)
+
+    a, b = _logged_losses(straight_dir), _logged_losses(rundir)
+    overlap = sorted(set(a) & set(b) & set(range(8, 16)))
+    assert len(overlap) >= 7, (sorted(a), sorted(b))
+    np.testing.assert_allclose(
+        [a[s] for s in overlap], [b[s] for s in overlap], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        resumed["metrics"]["loss/final"], straight["metrics"]["loss/final"],
+        rtol=1e-6,
+    )
+
+
+def test_preemption_emergency_save_and_exact_resume(
+    data_dir, runtime, straight16, tmp_path
+):
+    """Acceptance (d): SIGTERM (the `preempt` fault models its arrival
+    mid-step) -> emergency save lands at the step boundary, verified; the
+    resumed run continues the exact straight-run trajectory."""
+    straight, straight_dir = straight16
+    rundir = str(tmp_path)
+    cfg = base_config(data_dir, rundir=rundir, fault_plan="preempt@5")
+    interrupted = supervise(cfg, runtime=runtime)
+    assert interrupted["metrics"].get("preempted") is True
+    assert "loss/final" not in interrupted["metrics"]
+
+    mngr = CheckpointManager(rundir)
+    assert mngr.latest_verified_step() == 5  # emergency save, manifest-verified
+    mngr.close()
+
+    preempt.reset()
+    resumed = train(base_config(data_dir, rundir=rundir), runtime=runtime)
+    a, b = _logged_losses(straight_dir), _logged_losses(rundir)
+    overlap = sorted(set(a) & set(b) & set(range(6, 16)))
+    assert len(overlap) >= 9
+    np.testing.assert_allclose(
+        [a[s] for s in overlap], [b[s] for s in overlap], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        resumed["metrics"]["loss/final"], straight["metrics"]["loss/final"],
+        rtol=1e-6,
+    )
+
+
+def test_sigterm_handler_sets_flag():
+    """The real signal path (not the fault): SIGTERM flips the replicated
+    flag; install is one-shot so a second signal would reach the previous
+    handler."""
+    preempt.install_handlers((signal.SIGTERM,))
+    try:
+        assert not preempt.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preempt.requested()
+        assert preempt.any_host_requested()  # single-process: local flag
+        assert signal.getsignal(signal.SIGTERM) is not preempt.request  # one-shot
+    finally:
+        preempt.reset()
+    assert not preempt.requested()

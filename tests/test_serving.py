@@ -198,3 +198,64 @@ def test_serve_stochastic_sampling_runs(params):
         out = done[u].tokens
         assert len(out) == len(p) + m
         assert (out >= 0).all() and (out < CFG.vocab_size).all()
+
+
+def test_serve_request_ttl_timeout(params):
+    """Satellite (robustness PR): a deadline-expired request is finished
+    with status='timeout' (partial tokens returned, pages freed) instead of
+    occupying the pool forever — queued and running requests alike."""
+    from midgpt_tpu.sampling.serve import BackpressureError  # noqa: F401
+
+    eng = ServeEngine(
+        CFG, params, max_slots=1, page_size=8, num_pages=17,
+        prefill_chunk=16, cache_dtype=jnp.float32,
+    )
+    p = np.arange(5, dtype=np.int32)
+    # queued + already expired: cleared by the next round's expiry pass
+    u_dead = eng.submit(p, 8, ttl_s=0.0)
+    u_live = eng.submit(p, 8)
+    done = eng.run()
+    assert done[u_dead].status == "timeout"
+    assert len(done[u_dead].tokens) == len(p)  # nothing generated
+    assert done[u_live].status == "ok"
+    assert len(done[u_live].tokens) == len(p) + 8
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1  # all freed
+
+    # running slot: expire mid-generation -> partial tokens, pages freed
+    eng2 = ServeEngine(
+        CFG, params, max_slots=1, page_size=8, num_pages=17,
+        prefill_chunk=16, decode_chunk=1, cache_dtype=jnp.float32,
+    )
+    u = eng2.submit(p, 12, ttl_s=60.0)
+    for _ in range(3):
+        eng2.step()  # prefill + a couple of decode rounds
+    slot = next(s for s in eng2.slots if s is not None)
+    n_before = len(slot.generated)
+    assert 0 < n_before < 12
+    slot.request.deadline = 0.0  # force expiry deterministically
+    eng2.step()
+    assert eng2.slots[0] is None and u in eng2.finished
+    assert eng2.finished[u].status == "timeout"
+    assert len(eng2.finished[u].tokens) == len(p) + n_before
+    assert eng2.allocator.free_count == eng2.allocator.num_pages - 1
+
+
+def test_serve_backpressure_admission(params):
+    """Satellite (robustness PR): beyond max_backlog_pages, submit raises
+    BackpressureError instead of growing the queue without bound; capacity
+    frees as requests finish."""
+    from midgpt_tpu.sampling.serve import BackpressureError
+
+    eng = ServeEngine(
+        CFG, params, max_slots=2, page_size=8, num_pages=17,
+        prefill_chunk=16, cache_dtype=jnp.float32, max_backlog_pages=4,
+    )
+    p = np.arange(10, dtype=np.int32)  # 10 + 6 tokens -> 2 pages worst case
+    u1 = eng.submit(p, 6)
+    u2 = eng.submit(p, 6)
+    with pytest.raises(BackpressureError, match="backlog"):
+        eng.submit(p, 6)
+    done = eng.run()
+    assert done[u1].status == "ok" and done[u2].status == "ok"
+    u3 = eng.submit(p, 6)  # backlog drained: admission works again
+    assert eng.run()[u3].status == "ok"

@@ -1,0 +1,117 @@
+"""Chaos harness: a supervised training run with injected faults, one JSON
+summary line (the driver contract bench.py established).
+
+    python tools/chaos_run.py --config=shakespeare_char --rundir=/tmp/chaos \
+        --fault nan_grad@12 --fault ckpt_io_error*2 \
+        [--set max_steps=40 ...] [--max-restarts 3]
+
+Runs `robustness.supervisor.supervise` end to end — the REAL recovery path
+(rollback, window skip, checkpoint retry, manifest verification), not a
+mock — and reports what fired and what it cost. Fault spec grammar:
+`kind[@step][*times]` (robustness/faults.py; MIDGPT_FAULTS env works too).
+
+Platform selection follows launch.py: set MIDGPT_PLATFORM=cpu (and
+MIDGPT_CPU_DEVICES=8) to drive recovery scenarios on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_launch():
+    """launch.py is a top-level script, not a package module."""
+    spec = importlib.util.spec_from_file_location(
+        "launch_mod",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "launch.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", type=str, required=True)
+    parser.add_argument("--rundir", type=str, required=True)
+    parser.add_argument(
+        "--fault",
+        action="append",
+        default=[],
+        metavar="KIND[@STEP][*TIMES]",
+        help="fault to inject (repeatable) — robustness/faults.py",
+    )
+    parser.add_argument("--max-restarts", type=int, default=None)
+    parser.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="dotted config override (same semantics as launch.py)",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    if os.environ.get("MIDGPT_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["MIDGPT_PLATFORM"])
+        if os.environ.get("MIDGPT_CPU_DEVICES"):
+            from midgpt_tpu.utils.compat import set_cpu_device_count
+
+            set_cpu_device_count(int(os.environ["MIDGPT_CPU_DEVICES"]))
+
+    from midgpt_tpu.config import load_config
+    from midgpt_tpu.robustness import faults, preempt
+    from midgpt_tpu.robustness.supervisor import supervise
+
+    launch_mod = _load_launch()
+    config = load_config(args.config)
+    if args.set:
+        config = launch_mod.apply_overrides(
+            config, [kv.partition("=")[::2] for kv in args.set]
+        )
+    config = config.replace(rundir=os.path.abspath(args.rundir))
+    if args.fault:
+        config = config.replace(fault_plan=",".join(args.fault))
+    if args.max_restarts is not None:
+        config = config.replace(max_restarts=args.max_restarts)
+
+    preempt.install_handlers()
+    t0 = time.time()
+    status = "ok"
+    error = None
+    result = None
+    try:
+        result = supervise(config)
+    except (RuntimeError, FloatingPointError) as e:
+        # Budget exhaustion / unrecoverable divergence: that outcome IS the
+        # chaos result — report it as data, nonzero exit.
+        status = "failed"
+        error = str(e)
+    summary = {
+        "tool": "chaos_run",
+        "config": args.config,
+        "rundir": config.rundir,
+        "status": status,
+        "wall_s": round(time.time() - t0, 3),
+        "faults_requested": args.fault,
+        "faults_fired": faults.fired_counts(),
+    }
+    if result is not None:
+        summary["supervisor"] = {
+            k: v for k, v in result["supervisor"].items() if k != "faults_fired"
+        }
+        summary["loss_final"] = result["metrics"].get("loss/final")
+        summary["preempted"] = bool(result["metrics"].get("preempted", False))
+    if error is not None:
+        summary["error"] = error
+    print(json.dumps(summary))
+    return 0 if status == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,9 @@ def main() -> None:
 
     writer = ckpt_mod.CheckpointManager(dst, save_interval_steps=1)
     assert writer.save(step, out, force=True)
+    # close() barriers the async write AND commits the integrity manifest
+    # (training/checkpoint.py), so the migrated checkpoint is born verified
+    # and eligible for latest_verified_step resume.
     writer.close()
     print(f"migrated step {step}: {src} (v2) -> {dst} (v{ckpt_mod.FORMAT['version']})")
 
