@@ -112,6 +112,46 @@ def check_serve_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_spec_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --spec profile: speculative vs plain continuous
+    engine on the same trace (field table: docs/SERVING.md)."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "model": (dict,),
+            "draft_layers": (int,),
+            "spec_k_max": (int,),
+            "train_steps": (int,),
+            "baseline_tok_s": Number,
+            "spec_tok_s": Number,
+            "speedup_spec": Number,
+            "accept_rate": Number,
+            "tokens_per_verify": Number,
+            "hbm_target_cache_bytes": (int,),
+            "hbm_draft_cache_bytes": (int,),
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_spec":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_spec'"
+        )
+    ar = rec.get("accept_rate")
+    if isinstance(ar, Number) and not 0.0 <= ar <= 1.0:
+        problems.append(f"accept_rate {ar} outside [0, 1]")
+    tpv = rec.get("tokens_per_verify")
+    if isinstance(tpv, Number) and tpv < 1.0 and rec.get("n_requests", 0) > 0:
+        # every verify yields at least its correction/bonus token
+        problems.append(f"tokens_per_verify {tpv} < 1 — counter drift?")
+    return problems
+
+
 def check_graftcheck(rec: dict) -> tp.List[str]:
     """The graftcheck CLI's own --json line."""
     problems: tp.List[str] = []
@@ -141,6 +181,7 @@ def check_graftcheck(rec: dict) -> tp.List[str]:
 PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "train": check_train_bench,
     "serve": check_serve_bench,
+    "serve_spec": check_serve_spec_bench,
     "graftcheck": check_graftcheck,
 }
 

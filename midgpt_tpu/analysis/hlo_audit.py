@@ -119,6 +119,29 @@ def while_body_collectives(
     return census
 
 
+def while_body_pool_copies(
+    hlo_text: str, shape: str
+) -> tp.Dict[str, tp.List[str]]:
+    """{while_body: [copy instruction lines producing `shape`]}, transitive
+    through called computations — the zero-in-loop-cache-copy census. The
+    serving engine's perf story rests on its KV pools aliasing through loop
+    carries (decode chunk AND speculative verify): a pool-sized copy inside
+    a while body means every loop iteration re-materializes the pool
+    (2.5 ms/token measured when the r1-r4 decode structure did exactly
+    that, RESULTS.md §1). `shape` is the literal HLO shape string, e.g.
+    'f32[2,2,9,8,16]'. One-time entry copies OUTSIDE loop bodies are fine
+    and not counted."""
+    comps = hlo_computations(hlo_text)
+    wanted = re.compile(rf"= {re.escape(shape)}[^=]*copy\(")
+    census: tp.Dict[str, tp.List[str]] = {}
+    for body in sorted(while_body_names(hlo_text)):
+        hits: tp.List[str] = []
+        for comp in _reachable(comps, body):
+            hits.extend(l for l in comps.get(comp, ()) if wanted.search(l))
+        census[body] = hits
+    return census
+
+
 def assert_no_while_body_collectives(
     hlo_text: str, ops: tp.Sequence[str] = ("all-gather",)
 ) -> None:
@@ -253,4 +276,58 @@ def run_audit() -> tp.Dict[str, tp.Any]:
     census = while_body_collectives(decode_hlo)
     report["decode_while_bodies"] = {b: len(ls) for b, ls in census.items()}
     assert census, "decode program lowered without a while loop (scan vanished?)"
+
+    # Zero-in-loop-cache-copy census: the KV pool must alias through the
+    # decode loop's carry (the r5/r6 perf pin held by tests/test_sampling.py
+    # on bigger shapes), here audited on the same artifact the collective
+    # census reads.
+    pool_shape = f"f32[{mc.n_layer},{mc.n_head},9,8,{mc.head_dim}]"
+    copies = while_body_pool_copies(decode_hlo, pool_shape)
+    report["decode_loop_pool_copies"] = {b: len(ls) for b, ls in copies.items()}
+    assert all(not ls for ls in copies.values()), (
+        "pool-sized copies inside the decode while body: "
+        + str({b: ls[:1] for b, ls in copies.items() if ls})
+    )
+
+    # Speculative verify program (sampling/serve.py _spec_verify_chunk):
+    # same two audits. Lowered with decode_layer_scan=True so the layer
+    # loop is a while body — the unrolled lowering has no loop at all (its
+    # scatters alias the donated pool directly); the rolled scan is where
+    # a carry-aliasing regression would surface as in-loop pool copies.
+    import dataclasses
+
+    from midgpt_tpu.sampling.serve import _spec_verify_chunk
+
+    mc_scan = dataclasses.replace(mc, decode_layer_scan=True)
+    K = 2
+    verify_hlo = (
+        _spec_verify_chunk.lower(
+            mc_scan,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((K, B), jnp.int32),
+            jax.ShapeDtypeStruct((K, B, mc.vocab_size), jnp.float32),
+            cache_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        )
+        .compile()
+        .as_text()
+    )
+    assert_no_while_body_collectives(verify_hlo)
+    v_census = while_body_collectives(verify_hlo)
+    report["verify_while_bodies"] = {b: len(ls) for b, ls in v_census.items()}
+    assert v_census, "verify program lowered without its layer-scan while loop"
+    v_copies = while_body_pool_copies(verify_hlo, pool_shape)
+    report["verify_loop_pool_copies"] = {b: len(ls) for b, ls in v_copies.items()}
+    assert all(not ls for ls in v_copies.values()), (
+        "pool-sized copies inside the verify layer loop: "
+        + str({b: ls[:1] for b, ls in v_copies.items() if ls})
+    )
     return report

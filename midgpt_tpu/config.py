@@ -126,6 +126,20 @@ class ExperimentConfig:
     # Fault-injection plan ("kind[@step][*times],..." — robustness/faults.py),
     # activated once per supervised run; "" (default) injects nothing.
     fault_plan: str = ""
+    # ---- speculative decoding (sampling/spec.py, docs/SERVING.md) ----
+    # Self-draft depth for sampling/serving: the first spec_layers blocks of
+    # the model (sharing its embeddings/lm_head) propose tokens that the
+    # full model verifies in one batched paged forward. 0 (default)
+    # disables speculation — plain continuous-batching decode. Training is
+    # untouched by these knobs; sample.py --spec_layers overrides.
+    spec_layers: int = 0
+    # Bounds of the per-slot adaptive draft length k (both powers of two,
+    # like the decode-chunk buckets): the serve scheduler doubles/halves a
+    # slot's k from its recent acceptance EMA within [spec_k_min,
+    # spec_k_max]; spec_adapt=False pins k at spec_k_max.
+    spec_k_max: int = 4
+    spec_k_min: int = 1
+    spec_adapt: bool = True
     debug: bool = False
 
     def __post_init__(self):
@@ -285,6 +299,24 @@ class ExperimentConfig:
         sp = self.mesh.sp
         if sp == -1:
             sp = 1
+        if not 0 <= self.spec_layers < mc.n_layer:
+            # spec_layers == n_layer would "draft" with the target itself —
+            # all cost, no amortization — and deeper is shape-invalid.
+            raise ValueError(
+                f"spec_layers={self.spec_layers} must be in [0, n_layer="
+                f"{mc.n_layer})"
+            )
+        for k_name, k_val in (("spec_k_max", self.spec_k_max),
+                              ("spec_k_min", self.spec_k_min)):
+            if k_val < 1 or k_val & (k_val - 1):
+                # non-pow2 k would mint a fresh draft+verify program pair
+                # per value instead of riding the bucketed compile set
+                # (sampling/serve.py _spec_round)
+                raise ValueError(f"{k_name}={k_val} must be a power of two")
+        if self.spec_k_min > self.spec_k_max:
+            raise ValueError(
+                f"spec_k_min={self.spec_k_min} > spec_k_max={self.spec_k_max}"
+            )
         if self.data_step_offset < 0:
             # A negative offset would re-sample windows already consumed
             # before the rollback — the exact data the skip exists to avoid.

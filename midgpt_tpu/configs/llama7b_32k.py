@@ -26,6 +26,12 @@ config = ExperimentConfig(
     g_accum_iters=8,
     shard_model=True,
     mesh=MeshConfig(data=-1, fsdp=8, sp=8),
+    # Serving: self-draft speculative decoding with the first 8 of 32
+    # layers (1/4 depth); decode is weight-bandwidth-bound at 7B, so one
+    # verify sweep amortized over k accepted drafts is the dominant
+    # serving lever (docs/SERVING.md). k adapts in [1, 8] per slot.
+    spec_layers=8,
+    spec_k_max=8,
     model_config=GPTConfig(
         block_size=32768,
         vocab_size=50304,

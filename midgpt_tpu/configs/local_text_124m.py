@@ -33,6 +33,9 @@ config = ExperimentConfig(
     g_accum_iters=16,  # effective batch 256
     shard_model=False,
     mesh=MeshConfig(data=-1, fsdp=1, sp=1),
+    # Serving: 4-of-12-layer self-draft speculation for sample.py
+    # --engine=continuous (override with --spec_layers; docs/SERVING.md).
+    spec_layers=4,
     model_config=GPTConfig(
         block_size=1024,
         vocab_size=50304,

@@ -199,3 +199,48 @@ def paged_attention(
     if impl == "gather":
         return paged_attention_gather(q, k_pages, v_pages, page_table, lengths)
     raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+def paged_verify_attention(
+    q: Array,  # (B, T, H, C) — T = k+1 speculative positions per slot
+    k_pages: Array,  # (H, num_pages, page_size, C)
+    v_pages: Array,
+    page_table: Array,  # (B, max_pages) int32
+    counts: Array,  # (B, T) int32 — keys visible to row t of slot b
+    impl: str = "auto",
+) -> Array:
+    """Batched multi-row paged attention for speculative verification
+    (GPT.verify_step_paged): every slot scores its k+1 candidate positions
+    against its own pages in ONE call. Row t of slot b attends to
+    counts[b, t] keys — the caller passes lengths[b] + t + 1, which makes
+    the chunk causal through the cache: all rows' K/V are written before
+    the gather, and the per-row count hides the later rows.
+
+    Gather lowering only for now (pages gathered contiguous once, like
+    prefill_paged_chunk): the one-query-row online-softmax shape of the
+    Pallas decode kernel above does not fit a (B, T) query block, so a
+    multi-row verify kernel is the TPU upgrade path (docs/SERVING.md) —
+    'auto'/'gather' both take this path, 'kernel' fails loudly instead of
+    silently falling back. Same mask-then-scale-then-f32-softmax order as
+    `paged_attention_gather`, so speculative greedy verify stays
+    token-exact with plain paged decode (pinned by tests/test_spec.py)."""
+    if impl == "kernel":
+        raise NotImplementedError(
+            "no Pallas verify kernel yet — multi-row paged attention runs "
+            "the gather lowering (docs/SERVING.md upgrade path)"
+        )
+    B, T, H, C = q.shape
+    _, _, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    S = max_pages * page_size
+    flat = page_table.reshape(-1)
+    kg = jnp.take(k_pages, flat, axis=1)  # (H, B*max_pages, page_size, C)
+    kg = kg.reshape(H, B, S, C).transpose(1, 0, 2, 3)  # (B, H, S, C)
+    vg = jnp.take(v_pages, flat, axis=1).reshape(H, B, S, C).transpose(1, 0, 2, 3)
+    scores = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg)
+    valid = jnp.arange(S)[None, None, None, :] < counts[:, None, :, None]
+    scores = jnp.where(valid, scores, float("-inf"))
+    probs = jax.nn.softmax(
+        scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+    ).astype(q.dtype)
+    return jnp.einsum("bhtk,bhkc->bthc", probs, vg)  # (B, T, H, C)

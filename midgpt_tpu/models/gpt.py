@@ -1031,6 +1031,95 @@ class GPT:
         return logits, PagedKVCache(k=k_new, v=v_new)
 
     @staticmethod
+    def verify_step_paged(
+        config: GPTConfig,
+        params: GPTParams,
+        tokens: Array,  # (B, K1) int — [t_last, d_1, .., d_k] per slot
+        cache: "PagedKVCache",
+        page_table: Array,  # (B, max_pages) int32
+        lengths: Array,  # (B,) int32 — tokens already in slot b's cache
+        active: Array,  # (B,) bool
+        attn_impl: str = "auto",
+    ) -> tp.Tuple[Array, "PagedKVCache"]:
+        """Score K1 = k+1 candidate tokens per slot in ONE batched paged
+        forward — the target side of speculative decoding (sampling/spec.py).
+
+        Slot b's token t sits at absolute position lengths[b] + t: its K/V
+        is written there (same advanced-index scatter as decode_step_paged)
+        and its query attends to lengths[b] + t + 1 keys through the page
+        table — all K1 rows are written before the gather, so the per-row
+        count IS the causal mask (kernels/decode_attention.py
+        paged_verify_attention). Row t's logits score the token at position
+        lengths[b] + t + 1, i.e. row 0 judges d_1 and row K1-1 supplies the
+        bonus distribution.
+
+        Positions past the accepted prefix hold REJECTED speculative K/V
+        after the caller's rollback — that is deliberate: rollback is
+        host-side only (length counters reset, tail pages freed), the pool
+        is never rewritten, and the stale columns are masked by every later
+        read until the slot grows back over them (write-before-read, the
+        page-aligned rollback invariant, docs/SERVING.md). Inactive slots
+        write nothing (out-of-range redirect) and attend to the single sink
+        key. Same per-layer op order as decode_step_paged, so greedy
+        speculative serving stays token-identical to plain paged decode
+        (pinned by tests/test_spec.py).
+
+        Precondition (scheduler-enforced): lengths[b] + K1 <= block_size and
+        the page table covers position lengths[b] + K1 - 1 for active slots.
+
+        Returns (logits (B, K1, V), cache with the B*K1 columns written)."""
+        from midgpt_tpu.kernels.decode_attention import paged_verify_attention
+        from midgpt_tpu.ops.rope import apply_rope_positions
+
+        B, K1 = tokens.shape
+        C = config.head_dim
+        ps = cache.page_size
+        t_idx = jnp.arange(K1, dtype=jnp.int32)
+        positions = lengths[:, None] + t_idx[None, :]  # (B, K1)
+        active_i = active.astype(jnp.int32)
+        attn_counts = jnp.maximum(active_i[:, None] * (positions + 1), 1)
+        write_pages = jnp.where(
+            active[:, None],
+            jnp.take_along_axis(page_table, positions // ps, axis=1),
+            cache.num_pages,
+        )  # (B, K1); inactive writes dropped via XLA oob-scatter semantics
+        offs = positions % ps
+        x = jnp.take(params.wte, tokens, axis=0)  # (B, K1, D)
+        sin, cos = rope_table(C, config.block_size)
+
+        def block_fn(carry, block_and_idx):
+            x, ck_all, cv_all = carry  # pools (L, H, P, ps, C)
+            block, i = block_and_idx
+            h = rms_norm(x)
+            q, k, v = GPT._project_qkv(config, block, h)  # (B, K1, H, C)
+            q = apply_rope_positions(q, sin, cos, positions, style=config.rope_style)
+            k = apply_rope_positions(k, sin, cos, positions, style=config.rope_style)
+            # (B, K1)-indexed column scatter: i scalar x write_pages x offs
+            # broadcast to (B, K1) result dims, H and C ride as slices — the
+            # same in-place-aliasing shape as the decode/prefill scatters.
+            ck_all = ck_all.at[i, :, write_pages, offs, :].set(
+                k.astype(ck_all.dtype)
+            )
+            cv_all = cv_all.at[i, :, write_pages, offs, :].set(
+                v.astype(cv_all.dtype)
+            )
+            kp = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
+            vp = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
+            att = paged_verify_attention(
+                q, kp, vp, page_table, attn_counts, impl=attn_impl
+            )  # (B, K1, H, C)
+            x = GPT._attn_out_and_mlp(config, block, x, att.astype(x.dtype))
+            return (x, ck_all, cv_all), None
+
+        carry = GPT._decode_layer_loop(
+            config, block_fn, (x, cache.k, cache.v), params.blocks
+        )
+        x, k_new, v_new = carry
+        x = rms_norm(x, eps=1e-5)
+        logits = jnp.einsum("btd,vd->btv", x, params.lm_head)
+        return logits, PagedKVCache(k=k_new, v=v_new)
+
+    @staticmethod
     def prefill_paged_chunk(
         config: GPTConfig,
         params: GPTParams,

@@ -25,6 +25,41 @@ from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, KVCache
 Array = jax.Array
 
 
+def warp_logits(
+    logits: Array,  # (..., V) float32
+    temperature: float,
+    top_k: tp.Optional[int] = None,
+    top_p: tp.Optional[float] = None,
+) -> Array:
+    """Temperature scaling + top-k / nucleus filtering on f32 logits.
+
+    The warped logits DEFINE the sampling distribution: `sample_logits`
+    draws categorically from them, and the speculative-decoding rejection
+    sampler (sampling/spec.py) needs the same warped distribution for both
+    the draft and the target, so the filter lives here as a pure function.
+    Requires temperature > 0 (greedy has no distribution to warp); works on
+    any leading batch shape."""
+    logits = logits / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        # lax.top_k is O(V) selection of k values — not a full-vocab sort
+        # per token (the nucleus path below can't avoid its sort).
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        # nucleus: keep the smallest prefix of descending-prob tokens whose
+        # cumulative mass reaches top_p (the first token is always kept —
+        # its exclusive prefix mass is 0)
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive_cum < top_p
+        threshold = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
 def sample_logits(
     logits: Array,  # (B, V) float
     key: Array,
@@ -36,25 +71,9 @@ def sample_logits(
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
-    if top_k is not None and top_k < logits.shape[-1]:
-        # lax.top_k is O(V) selection of k values — not a full-vocab sort
-        # per token (the nucleus path below can't avoid its sort).
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None and top_p < 1.0:
-        # nucleus: keep the smallest prefix of descending-prob tokens whose
-        # cumulative mass reaches top_p (the first token is always kept —
-        # its exclusive prefix mass is 0)
-        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
-        keep = exclusive_cum < top_p
-        threshold = jnp.min(
-            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < threshold, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    return jax.random.categorical(
+        key, warp_logits(logits, temperature, top_k, top_p), axis=-1
+    )
 
 
 @functools.partial(jax.jit, static_argnums=(0, 4, 5, 6))

@@ -28,6 +28,17 @@ Scheduling is host-side and runs every round (`ServeEngine.step`):
      {decode_chunk, decode_chunk/2, ..., 1}). Page tables and lengths are
      plain jit inputs — admitting/finishing requests never recompiles.
 
+With a draft model configured, step 3 becomes a SPECULATIVE round instead:
+the draft proposes k tokens per slot against the paged cache (one scanned
+program), the target scores all k+1 positions in one batched paged verify
+forward, and a rejection sampler commits the longest valid prefix + one
+corrected/bonus token — exactly the target's distribution, any acceptance
+rate (sampling/spec.py; `_spec_round`; docs/SERVING.md "Speculative
+decoding"). k adapts per slot from the recent acceptance EMA over the pow2
+buckets [spec_k_min, spec_k_max]; rejected tail positions roll back
+page-aligned (length counters reset, tail pages freed, device pool never
+rewritten).
+
 When the pool runs dry, the scheduler EVICTS the youngest running slot
 (frees its pages, pushes the request back to the queue front with its
 generated tokens folded into the prompt — recompute-style preemption), so
@@ -61,7 +72,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, PagedKVCache
-from midgpt_tpu.sampling.engine import sample_logits
+from midgpt_tpu.sampling.engine import sample_logits, warp_logits
+from midgpt_tpu.sampling.spec import speculative_accept
 
 Array = jax.Array
 
@@ -119,6 +131,101 @@ def _serve_decode_chunk(
     return cache, toks
 
 
+@functools.partial(
+    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11), donate_argnums=(3,)
+)
+def _spec_draft_chunk(
+    config,  # the DRAFT model's GPTConfig
+    params,  # the DRAFT model's params
+    token,  # (B,) int32 — each slot's pending token
+    cache,  # draft PagedKVCache (donated)
+    page_table,  # (B, max_pages) int32 — SHARED with the target pool
+    lengths,  # (B,) int32
+    active,  # (B,) bool
+    k_steps: int,
+    temperature: float,
+    top_k,
+    top_p,
+    attn_impl: str,
+    key=None,
+):
+    """k_steps autoregressive draft proposals for the whole slot batch as
+    ONE device program: a scan of paged decode steps of the draft model
+    against the draft pool. Returns (cache, drafts (k, B) int32, probs
+    (k, B, V) f32) where probs[i] is the warped draft distribution proposal
+    i was drawn from — the q_i the verify program's rejection sampler
+    needs. Compiled once per (k bucket, page bucket), independent of
+    request mix (pinned by tests/test_recompile_pins.py)."""
+
+    def body(carry, _):
+        token, cache, lengths, key = carry
+        if key is not None:
+            key, k = jax.random.split(key)
+        logits, cache = GPT.decode_step_paged(
+            config, params, token, cache, page_table, lengths, active,
+            attn_impl=attn_impl,
+        )
+        lf = logits.astype(jnp.float32)
+        if temperature == 0.0:
+            probs = jax.nn.softmax(lf, axis=-1)
+            nxt = jnp.argmax(lf, axis=-1)
+        else:
+            warped = warp_logits(lf, temperature, top_k, top_p)
+            probs = jax.nn.softmax(warped, axis=-1)
+            nxt = jax.random.categorical(k, warped, axis=-1)
+        nxt = jnp.where(active, nxt.astype(token.dtype), token)
+        lengths = lengths + active.astype(lengths.dtype)
+        return (nxt, cache, lengths, key), (nxt, probs)
+
+    (_, cache, _, _), (toks, probs) = jax.lax.scan(
+        body, (token, cache, lengths, key), None, length=k_steps
+    )
+    return cache, toks, probs
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 9, 10, 11, 12), donate_argnums=(5,)
+)
+def _spec_verify_chunk(
+    config,
+    params,
+    token,  # (B,) int32 — each slot's pending token
+    drafts,  # (k, B) int32 — _spec_draft_chunk output, never landed on host
+    draft_probs,  # (k, B, V) f32
+    cache,  # target PagedKVCache (donated)
+    page_table,
+    lengths,
+    active,
+    temperature: float,
+    top_k,
+    top_p,
+    attn_impl: str,
+    key=None,
+):
+    """One batched paged verify forward over [pending, d_1..d_k] plus the
+    rejection sampler (sampling/spec.py): returns (cache, n_accept (B,),
+    out (B, k+1)) — the host emits out[b, :n_accept[b] + 1] per active
+    slot. k rides the drafts shape, so the program set is one per (k
+    bucket, page bucket) like the draft program."""
+    tokens = jnp.concatenate(
+        [token[:, None], drafts.T.astype(token.dtype)], axis=1
+    )  # (B, k+1)
+    logits, cache = GPT.verify_step_paged(
+        config, params, tokens, cache, page_table, lengths, active,
+        attn_impl=attn_impl,
+    )
+    n_accept, out = speculative_accept(
+        logits,
+        jnp.transpose(draft_probs, (1, 0, 2)),
+        drafts.T.astype(jnp.int32),
+        key,
+        temperature,
+        top_k,
+        top_p,
+    )
+    return cache, jnp.where(active, n_accept, 0), out
+
+
 class PageAllocator:
     """Free-list allocator over the pool's pages. Page 0 is the SINK
     (absorbs inactive-slot writes, models/gpt.py PagedKVCache) and is never
@@ -169,6 +276,12 @@ class _Slot:
     prompt_pos: int = 0  # prompt tokens prefilled so far
     generated: tp.List[int] = dataclasses.field(default_factory=list)
     token_times: tp.List[float] = dataclasses.field(default_factory=list)
+    # speculative-decoding state (draft engines only): current per-slot
+    # draft length and the acceptance EMA that adapts it. The EMA starts
+    # optimistic (1.0) so the first round can never halve k before any
+    # evidence exists.
+    spec_k: int = 1
+    accept_ema: float = 1.0
 
     @property
     def prefilling(self) -> bool:
@@ -207,6 +320,12 @@ class ServeEngine:
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "auto",
         max_backlog_pages: tp.Optional[int] = None,
+        draft_params: tp.Optional[GPTParams] = None,
+        draft_config: tp.Optional[GPTConfig] = None,
+        draft_shares_cache: bool = False,
+        spec_k_max: int = 4,
+        spec_k_min: int = 1,
+        spec_adapt: bool = True,
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
         self.config = config
@@ -232,6 +351,67 @@ class ServeEngine:
         self.cache = PagedKVCache.init(
             config, num_pages=num_pages, page_size=page_size, dtype=cache_dtype
         )
+        # ---- speculative decoding (docs/SERVING.md) ----
+        # A draft model turns every decode round into draft-k-then-verify:
+        # the draft proposes spec_k tokens against its OWN paged pool, the
+        # target scores them in one verify forward, and a rejection sampler
+        # keeps the longest valid prefix (+1 corrected/bonus token). The
+        # draft pool shares the page table and allocator with the target —
+        # one logical page maps to the same physical index in both pools —
+        # so the scheduler stays single-track.
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config come together")
+        if draft_config is not None:
+            if draft_config.block_size != config.block_size:
+                raise ValueError(
+                    f"draft block_size {draft_config.block_size} != target "
+                    f"{config.block_size} — the shared page table assumes "
+                    "equal position spaces"
+                )
+            for k_name, k_val in (("spec_k_max", spec_k_max),
+                                  ("spec_k_min", spec_k_min)):
+                if k_val < 1 or k_val & (k_val - 1):
+                    raise ValueError(f"{k_name}={k_val} must be a power of two")
+            if spec_k_min > spec_k_max:
+                raise ValueError(
+                    f"spec_k_min={spec_k_min} > spec_k_max={spec_k_max}"
+                )
+            if draft_shares_cache and (
+                draft_config.n_head != config.n_head
+                or draft_config.head_dim != config.head_dim
+                or draft_config.n_layer >= config.n_layer
+            ):
+                raise ValueError(
+                    "draft_shares_cache requires a layer-prefix draft: same "
+                    "n_head/head_dim, fewer layers (sampling/spec.py "
+                    "self_draft)"
+                )
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.draft_shares_cache = draft_shares_cache
+        self.spec_k_max = spec_k_max
+        self.spec_k_min = spec_k_min
+        self.spec_adapt = spec_adapt
+        # A layer-prefix self-draft needs no pool of its own: draft layer i
+        # IS target layer i, so the committed K/V it must attend to already
+        # sit in the target pool's first n_draft layers, and its speculative
+        # writes there are the same values the verify forward rewrites. The
+        # draft then also skips prompt prefill entirely — the target's
+        # prefill filled its layers. A separate draft model gets a dedicated
+        # pool (same page table/allocator: one logical page, two pools).
+        self.draft_cache = (
+            None
+            if draft_config is None or draft_shares_cache
+            else PagedKVCache.init(
+                draft_config, num_pages=num_pages, page_size=page_size,
+                dtype=cache_dtype,
+            )
+        )
+        # aggregate speculative counters (spec_stats)
+        self._spec_rounds = 0
+        self._spec_verifies = 0  # (slot, round) pairs
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self.slots: tp.List[tp.Optional[_Slot]] = [None] * max_slots
         self.queue: tp.List[Request] = []
         self.finished: tp.Dict[int, FinishedRequest] = {}
@@ -327,17 +507,22 @@ class ServeEngine:
         return {
             "prefill": jit_cache_size(_serve_prefill_chunk),
             "decode": jit_cache_size(_serve_decode_chunk),
+            "spec_draft": jit_cache_size(_spec_draft_chunk),
+            "spec_verify": jit_cache_size(_spec_verify_chunk),
         }
 
     # -- scheduling round ----------------------------------------------
 
     def step(self) -> None:
         """One round: expire -> admit -> one prefill chunk -> one decode
-        chunk."""
+        chunk (or one draft-then-verify speculative round)."""
         self._expire_round()
         self._admit()
         self._prefill_round()
-        self._decode_round()
+        if self.draft_params is not None:
+            self._spec_round()
+        else:
+            self._decode_round()
 
     def _expire_round(self) -> None:
         """Finish every deadline-expired request with a `timeout` status.
@@ -380,7 +565,10 @@ class ServeEngine:
         for i, s in enumerate(self.slots):
             if s is None and self.queue:
                 req = self.queue.pop(0)
-                self.slots[i] = _Slot(req, self._admitted)
+                # A preempted request restarts its k adaptation from
+                # spec_k_max like a fresh one — the draft pool it re-prefills
+                # is fresh too, so old acceptance evidence is stale anyway.
+                self.slots[i] = _Slot(req, self._admitted, spec_k=self.spec_k_max)
                 self._admitted += 1
 
     def _ensure_pages(self, slot: _Slot, upto_tokens: int) -> bool:
@@ -473,15 +661,34 @@ class ServeEngine:
         chunk[0, :n_valid] = prompt[slot.prompt_pos : slot.prompt_pos + n_valid]
         bucket = self._page_bucket(slot.prompt_pos + n_valid)
         row = jnp.asarray(self._page_table(bucket)[slot_i : slot_i + 1])
+        chunk_j = jnp.asarray(chunk)
+        start_j = jnp.asarray(slot.prompt_pos, jnp.int32)
+        n_valid_j = jnp.asarray(n_valid, jnp.int32)
         logits, self.cache = _serve_prefill_chunk(
             self.config,
             self.params,
-            jnp.asarray(chunk),
-            jnp.asarray(slot.prompt_pos, jnp.int32),
-            jnp.asarray(n_valid, jnp.int32),
+            chunk_j,
+            start_j,
+            n_valid_j,
             self.cache,
             row,
         )
+        if self.draft_params is not None and not self.draft_shares_cache:
+            # A separate draft model's pool must hold the same positions as
+            # the target's — the spec round's draft steps attend through the
+            # shared page table under the same per-slot lengths. Draft
+            # prefill logits are discarded (the pending token is sampled
+            # from the TARGET). A prefix self-draft skips this: the target
+            # prefill above already filled its layers of the shared pool.
+            _, self.draft_cache = _serve_prefill_chunk(
+                self.draft_config,
+                self.draft_params,
+                chunk_j,
+                start_j,
+                n_valid_j,
+                self.draft_cache,
+                row,
+            )
         slot.prompt_pos += n_valid
         slot.length = slot.prompt_pos
         if not slot.prefilling:
@@ -579,6 +786,161 @@ class ServeEngine:
                 slot.length += 1
                 if self._append_token(i, slot, int(toks[j, i]), t_done):
                     break  # finished (max_new or EOS); rest of chunk discarded
+
+    def _spec_round(self) -> None:
+        """One speculative round: k draft proposals per active slot (one
+        program), one batched k+1-token verify forward + rejection sampler
+        (one program), then host-side commit and page-aligned rollback.
+
+        Rollback never touches device memory: a slot that accepted j of k
+        drafts sets length = old + 1 + j and frees the tail pages past
+        ceil(length / page_size) — the rejected columns stay in the pool,
+        masked by every later read until the slot grows back over them
+        (write-before-read; GPT.verify_step_paged docstring). k for the
+        round is the pow2 min of the active slots' adaptive spec_k, so the
+        compile set is one draft + one verify program per k bucket
+        (tests/test_recompile_pins.py)."""
+        active_idx = [
+            i
+            for i, s in enumerate(self.slots)
+            if s is not None and not s.prefilling and s.remaining > 0
+        ]
+        if not active_idx:
+            return
+        S = self.config.block_size
+        # submit() caps prompt + max_new at S, so an unfinished slot always
+        # has length <= S - 2 and k_cap >= 1; the fallback is defensive
+        # (a plain decode round also keeps the draft pool one round stale,
+        # which only costs acceptance, never correctness).
+        k_cap = min(S - 1 - self.slots[i].length for i in active_idx)
+        budget = min([k_cap] + [self.slots[i].spec_k for i in active_idx])
+        if budget < 1:
+            self._decode_round()
+            return
+        k = 1 << (budget.bit_length() - 1)  # largest power of two <= budget
+        for i in list(active_idx):
+            slot = self.slots[i]
+            if slot is None:
+                # evicted by an older slot's page growth earlier in this loop
+                active_idx.remove(i)
+                continue
+            if not self._ensure_pages(slot, slot.length + k + 1):
+                active_idx.remove(i)  # pool held by older slots; wait
+        active_idx = [i for i in active_idx if self.slots[i] is not None]
+        if not active_idx:
+            return
+
+        token = np.zeros((self.max_slots,), np.int32)
+        lengths = np.zeros((self.max_slots,), np.int32)
+        active = np.zeros((self.max_slots,), bool)
+        for i in active_idx:
+            s = self.slots[i]
+            token[i] = s.generated[-1] if s.generated else s.request.prompt[-1]
+            lengths[i] = s.length
+            active[i] = True
+        if self.temperature == 0.0:
+            key_d = key_v = None
+        else:
+            self._key, key_d, key_v = jax.random.split(self._key, 3)
+        bucket = self._page_bucket(
+            max(self.slots[i].length for i in active_idx) + k + 1
+        )
+        table = jnp.asarray(self._page_table(bucket))
+        token_j = jnp.asarray(token)
+        lengths_j = jnp.asarray(lengths)
+        active_j = jnp.asarray(active)
+        # drafts/draft_probs stay on device between the two dispatches —
+        # the host only ever reads the small (B,) / (B, k+1) verify outputs.
+        # With a prefix self-draft the draft steps run against the TARGET
+        # pool (its first n_draft layers — ctor comment): the pool is
+        # donated to the draft program and the returned one (speculative
+        # columns written at the prefix layers) feeds verify, which
+        # rewrites those columns with the identical values.
+        shared = self.draft_shares_cache
+        draft_cache_in = self.cache if shared else self.draft_cache
+        draft_cache_out, drafts, draft_probs = _spec_draft_chunk(
+            self.draft_config,
+            self.draft_params,
+            token_j,
+            draft_cache_in,
+            table,
+            lengths_j,
+            active_j,
+            k,
+            self.temperature,
+            self.top_k,
+            self.top_p,
+            self.attn_impl,
+            key_d,
+        )
+        if shared:
+            self.cache = draft_cache_out
+        else:
+            self.draft_cache = draft_cache_out
+        self.cache, n_accept, out = _spec_verify_chunk(
+            self.config,
+            self.params,
+            token_j,
+            drafts,
+            draft_probs,
+            self.cache,
+            table,
+            lengths_j,
+            active_j,
+            self.temperature,
+            self.top_k,
+            self.top_p,
+            self.attn_impl,
+            key_v,
+        )
+        n_accept = np.asarray(n_accept)
+        out = np.asarray(out)  # forces both dispatches
+        t_done = time.perf_counter()
+        self._spec_rounds += 1
+        for i in active_idx:
+            slot = self.slots[i]
+            if slot is None:
+                continue
+            j = int(n_accept[i])
+            slot.length += 1 + j  # pending + accepted drafts are now cached
+            self._spec_verifies += 1
+            self._spec_drafted += k
+            self._spec_accepted += j
+            rate = j / k
+            slot.accept_ema = 0.5 * slot.accept_ema + 0.5 * rate
+            if self.spec_adapt:
+                if slot.accept_ema > 0.75 and slot.spec_k * 2 <= self.spec_k_max:
+                    slot.spec_k *= 2
+                elif slot.accept_ema < 0.4 and slot.spec_k // 2 >= self.spec_k_min:
+                    slot.spec_k //= 2
+            finished = False
+            for t in range(j + 1):
+                if self._append_token(i, slot, int(out[i, t]), t_done):
+                    finished = True  # EOS/budget; rest of the round discarded
+                    break
+            if finished:
+                continue
+            # page-aligned rollback: drop tail pages past the committed
+            # length; the partial last page keeps its stale columns (masked)
+            keep = -(-slot.length // self.page_size)
+            if len(slot.pages) > keep:
+                tail = slot.pages[keep:]
+                del slot.pages[keep:]
+                self.allocator.free(tail)
+
+    def spec_stats(self) -> tp.Dict[str, float]:
+        """Aggregate speculative counters since construction: acceptance
+        rate (accepted drafts / drafted) and tokens emitted per verify
+        forward per slot (1.0 would mean speculation never pays — every
+        verify also yields its correction/bonus token)."""
+        drafted = max(self._spec_drafted, 1)
+        verifies = max(self._spec_verifies, 1)
+        return {
+            "rounds": self._spec_rounds,
+            "accept_rate": self._spec_accepted / drafted,
+            "tokens_per_verify": (self._spec_accepted + self._spec_verifies)
+            / verifies,
+        }
 
     def _append_token(self, slot_i: int, slot: _Slot, tok: int, t: float) -> bool:
         """Record one generated token; returns True if the request finished

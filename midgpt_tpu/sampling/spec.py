@@ -1,0 +1,126 @@
+"""Speculative decoding primitives: self-draft construction and the exact
+rejection sampler.
+
+Autoregressive decode pays one full sweep of the target model's weights per
+generated token. Speculative decoding (Leviathan et al. 2023, "Fast
+Inference from Transformers via Speculative Decoding") amortizes that
+sweep: a cheap DRAFT model proposes k tokens autoregressively, the target
+scores all k+1 positions in one batched forward (`GPT.verify_step_paged`),
+and a rejection sampler accepts the longest valid prefix plus one corrected
+token. The output distribution equals the target's EXACTLY — the draft only
+changes the acceptance rate (throughput), never the samples:
+
+  * token d_i (drawn from warped draft distribution q_i) is accepted with
+    probability min(1, p_i[d_i] / q_i[d_i]) where p_i is the warped target
+    distribution at that position;
+  * the first rejection is replaced by a draw from norm(max(p_i - q_i, 0))
+    — the residual that makes accept + reject marginalize to p_i;
+  * a fully accepted chain appends a FREE bonus token drawn from p_{k+1}
+    (the target scored k+1 positions, so the last draw costs nothing).
+
+Greedy (temperature=0) degenerates to argmax equality per position, which
+makes speculative greedy decode token-identical to plain greedy decode
+(pinned by tests/test_spec.py).
+
+The engine wiring (draft rounds interleaved with verify rounds, per-slot
+adaptive k, page-aligned cache rollback) lives in sampling/serve.py;
+docs/SERVING.md documents the invariants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.models.gpt import GPTConfig, GPTParams
+from midgpt_tpu.sampling.engine import warp_logits
+
+Array = jax.Array
+
+
+def self_draft(
+    config: GPTConfig, params: GPTParams, n_draft_layers: int
+) -> tp.Tuple[GPTConfig, GPTParams]:
+    """Build a draft model from the first `n_draft_layers` blocks of the
+    target, sharing its embedding and lm_head.
+
+    No training, no extra checkpoint: early blocks of a converged decoder
+    already carry most of the next-token signal, and the shared wte/lm_head
+    keep the draft's output space aligned with the target's. `wte` and
+    `lm_head` are the SAME arrays (zero copy); the block slice materializes
+    n_draft_layers/n_layer of the stacked block weights. Residual-stream
+    compatibility is structural: blocks are pre-norm residual updates, so
+    truncating the stack still feeds the final norm a valid stream."""
+    if not 0 < n_draft_layers < config.n_layer:
+        raise ValueError(
+            f"n_draft_layers={n_draft_layers} must be in [1, "
+            f"n_layer={config.n_layer})"
+        )
+    draft_config = dataclasses.replace(config, n_layer=n_draft_layers)
+    blocks = jax.tree.map(lambda a: a[:n_draft_layers], params.blocks)
+    return draft_config, GPTParams(
+        wte=params.wte, blocks=blocks, lm_head=params.lm_head
+    )
+
+
+def speculative_accept(
+    target_logits: Array,  # (B, k+1, V) — verify forward, rows 0..k
+    draft_probs: Array,  # (B, k, V) f32 — warped draft dist of each proposal
+    drafts: Array,  # (B, k) int32 — the proposed tokens
+    key: tp.Optional[Array],
+    temperature: float,
+    top_k: tp.Optional[int] = None,
+    top_p: tp.Optional[float] = None,
+) -> tp.Tuple[Array, Array]:
+    """The rejection sampler (module docstring): returns (n_accept (B,)
+    int32, out (B, k+1) int32). out[:, :n_accept] are the accepted drafts
+    verbatim; out[:, n_accept] is the correction (on rejection) or the
+    bonus token (all k accepted) — the caller emits out[:, :n_accept + 1].
+
+    Row i of target_logits scores the position AFTER input token i (the
+    verify input is [t_last, d_1, .., d_k]), so draft d_{i+1} is judged by
+    row i and row k supplies the bonus distribution. Exactness — each
+    emitted token distributed as a sequential draw from the warped target —
+    is pinned statistically by tests/test_spec.py against a deliberately
+    wrong draft."""
+    B, K1, _ = target_logits.shape
+    K = K1 - 1
+    assert K >= 1, "speculation needs at least one drafted token"
+    tl = target_logits.astype(jnp.float32)
+    if temperature == 0.0:
+        tgt = jnp.argmax(tl, axis=-1)  # (B, k+1) per-position greedy tokens
+        acc = drafts == tgt[:, :K]
+        n_accept = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+        corr = jnp.take_along_axis(tgt, n_accept[:, None], axis=1)[:, 0]
+    else:
+        p = jax.nn.softmax(warp_logits(tl, temperature, top_k, top_p), axis=-1)
+        k_u, k_r = jax.random.split(key)
+        p_d = jnp.take_along_axis(p[:, :K], drafts[..., None], axis=-1)[..., 0]
+        q_d = jnp.take_along_axis(draft_probs, drafts[..., None], axis=-1)[..., 0]
+        # accept iff u < p/q, written u*q < p so q=0 (a token the draft
+        # filter zeroed but the caller force-fed) accepts whenever p > 0
+        u = jax.random.uniform(k_u, (B, K))
+        acc = u * q_d < p_d
+        n_accept = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+        r = n_accept[:, None, None]
+        p_r = jnp.take_along_axis(p, r, axis=1)[:, 0]  # (B, V)
+        q_r = jnp.take_along_axis(
+            draft_probs, jnp.minimum(r, K - 1), axis=1
+        )[:, 0]
+        resid = jnp.where(
+            (n_accept == K)[:, None], p_r, jnp.maximum(p_r - q_r, 0.0)
+        )
+        # numerically-empty residual (p <= q everywhere yet u rejected — only
+        # reachable through rounding) falls back to the target row itself
+        resid = jnp.where(
+            jnp.sum(resid, axis=-1, keepdims=True) > 0.0, resid, p_r
+        )
+        corr = jax.random.categorical(k_r, jnp.log(resid), axis=-1)
+    out = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    out = out.at[jnp.arange(B), n_accept].set(corr.astype(jnp.int32))
+    return n_accept.astype(jnp.int32), out

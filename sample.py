@@ -41,7 +41,24 @@ def main() -> None:
         "--max_slots", type=int, default=4,
         help="continuous engine: concurrent decode slots",
     )
+    parser.add_argument(
+        "--spec_layers", type=int, default=None,
+        help="speculative decoding with a SELF-DRAFT of this many leading "
+        "layers (shared embeddings/lm_head, sampling/spec.py). Default: the "
+        "checkpoint config's spec_layers (0 = off); 0 forces it off. "
+        "Implies --engine=continuous",
+    )
+    parser.add_argument(
+        "--draft_ckpt", type=str, default=None,
+        help="speculative decoding with a SEPARATE draft checkpoint dir "
+        "(its own config.json; must share vocab and block_size). Implies "
+        "--engine=continuous; mutually exclusive with --spec_layers",
+    )
     args = parser.parse_args()
+    if args.draft_ckpt is not None and args.spec_layers:
+        parser.error("--draft_ckpt and --spec_layers are mutually exclusive")
+    if args.draft_ckpt is not None or args.spec_layers:
+        args.engine = "continuous"  # speculation lives in the serve engine
 
     import jax
 
@@ -125,6 +142,32 @@ def main() -> None:
     if args.engine == "continuous":
         from midgpt_tpu.sampling.serve import ServeEngine
 
+        draft_config = draft_params = None
+        draft_shares_cache = False
+        spec_layers = (
+            config.spec_layers if args.spec_layers is None else args.spec_layers
+        )
+        if args.draft_ckpt is not None:
+            # Separate small draft model: restore its own checkpoint; the
+            # rejection sampler only needs matching output spaces.
+            with open(os.path.join(args.draft_ckpt, "config.json")) as f:
+                draft_exp = from_json(f.read())
+            draft_config = draft_exp.model_config
+            draft_params, draft_step = restore_for_sampling(
+                args.draft_ckpt, draft_exp
+            )
+            draft_params = cast_floating(
+                draft_params, jnp.dtype(config.compute_dtype)
+            )
+            print(f"draft checkpoint step {draft_step} ({args.draft_ckpt})")
+        elif spec_layers:
+            from midgpt_tpu.sampling.spec import self_draft
+
+            draft_config, draft_params = self_draft(
+                model_cfg, params, spec_layers
+            )
+            draft_shares_cache = True  # prefix layers ride the target pool
+            print(f"self-draft: first {spec_layers}/{model_cfg.n_layer} layers")
         eng = ServeEngine(
             model_cfg,
             params,
@@ -133,6 +176,12 @@ def main() -> None:
             top_k=args.top_k,
             top_p=args.top_p,
             seed=args.seed,
+            draft_params=draft_params,
+            draft_config=draft_config,
+            draft_shares_cache=draft_shares_cache,
+            spec_k_max=config.spec_k_max,
+            spec_k_min=config.spec_k_min,
+            spec_adapt=config.spec_adapt,
         )
         uids = [
             eng.submit(prompt[i], args.max_new_tokens)
@@ -140,6 +189,12 @@ def main() -> None:
         ]
         finished = eng.run()
         out = [finished[u].tokens for u in uids]
+        if draft_params is not None:
+            s = eng.spec_stats()
+            print(
+                f"speculative: accept_rate {s['accept_rate']:.2f}, "
+                f"tokens/verify {s['tokens_per_verify']:.2f}"
+            )
     else:
         out = generate(
             model_cfg,

@@ -59,6 +59,41 @@ def test_bench_serve_emits_conformant_json_line(capsys):
     assert rec["compile_counts"]["prefill"] >= 1
 
 
+def test_bench_serve_spec_emits_conformant_json_line(capsys):
+    """--spec mode: the serve_spec profile (speculative vs plain continuous
+    engine) must hold the one-JSON-line contract too. Tiny shapes, 2 quick
+    train steps — structure check, not a perf claim."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--spec",
+            "--n-requests", "2",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+            "--spec-draft-layers", "1",
+            "--spec-k", "4",
+            "--train-steps", "2",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_spec")
+    assert not problems, problems
+    assert rec["draft_layers"] == 1 and rec["spec_k_max"] == 4
+    assert rec["baseline_tok_s"] > 0 and rec["spec_tok_s"] > 0
+    assert 0.0 <= rec["accept_rate"] <= 1.0
+    assert rec["tokens_per_verify"] >= 1.0
+    assert rec["compile_counts"]["spec_draft"] >= 1
+    assert rec["compile_counts"]["spec_verify"] >= 1
+    # prefix self-draft: speculation must not cost extra cache HBM
+    assert rec["hbm_draft_cache_bytes"] == 0
+
+
 def test_bench_train_emits_conformant_json_line(capsys):
     out = _run_entry_point(
         os.path.join(REPO, "bench.py"),

@@ -31,6 +31,9 @@ def test_at_scale_config_train_step_lowers(name):
         # 8-device test mesh — round the batch up, shapes are abstract anyway.
         batch_size=-(-config.batch_size // 8) * 8,
         model_config=dataclasses.replace(config.model_config, n_layer=2),
+        # serving-only knob: must shrink with n_layer (validated against
+        # it) and is irrelevant to the train step being lowered here
+        spec_layers=min(config.spec_layers, 1),
     )
     lowered = _lower_train_step(config)
     assert "main" in lowered.as_text()[:2000]

@@ -94,6 +94,64 @@ def test_serve_mixes_exactly_one_decode_compile(params):
     assert jit_cache_size(_serve_decode_chunk) == d1
 
 
+def test_spec_mixes_one_draft_and_verify_program_per_k_bucket(params):
+    """Satellite pin: across 4 request mixes with varying acceptance
+    patterns (different seeds — acceptance is DATA, so it must never be a
+    compile key), the engine compiles exactly one draft program and one
+    verify program per k-bucket. Mix design mirrors the decode pin above:
+    prompts 31..47 pin the page bucket at the 8-page cap from the first
+    speculative round even at k=1 (length + k + 1 >= 33), prompt + max_new
+    <= 60 keeps capacity from ever clamping k, and the 25-page pool never
+    evicts. k is pinned per engine (spec_adapt=False, k_min=k_max) the way
+    decode lengths are pow2-bucketed."""
+    from midgpt_tpu.sampling.serve import _spec_draft_chunk, _spec_verify_chunk
+    from midgpt_tpu.sampling.spec import self_draft
+
+    dcfg, dparams = self_draft(CFG, params, 1)
+
+    def spec_mix(k, seed, lengths=(31, 38, 45), max_new=(13, 9, 15)):
+        eng = ServeEngine(
+            CFG,
+            params,
+            max_slots=3,
+            page_size=8,
+            num_pages=25,
+            prefill_chunk=16,
+            temperature=0.0,
+            cache_dtype=jnp.float32,
+            draft_params=dparams,
+            draft_config=dcfg,
+            draft_shares_cache=True,
+            spec_k_max=k,
+            spec_k_min=k,
+            spec_adapt=False,
+        )
+        rng = np.random.default_rng(seed)
+        uids = {
+            eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+            for n, m in zip(lengths, max_new)
+        }
+        done = eng.run()
+        assert set(done) == uids
+        return eng
+
+    d0 = jit_cache_size(_spec_draft_chunk)
+    v0 = jit_cache_size(_spec_verify_chunk)
+    spec_mix(4, seed=0)  # k-bucket 4, acceptance pattern A
+    spec_mix(4, seed=1, lengths=(33, 40, 47), max_new=(9, 11, 13))  # pattern B
+    assert jit_cache_size(_spec_draft_chunk) - d0 == 1, "draft: one program per k"
+    assert jit_cache_size(_spec_verify_chunk) - v0 == 1, "verify: one program per k"
+    spec_mix(1, seed=2)  # second k-bucket
+    assert jit_cache_size(_spec_draft_chunk) - d0 == 2
+    assert jit_cache_size(_spec_verify_chunk) - v0 == 2
+    with CompileCounter() as cc:
+        spec_mix(4, seed=3, lengths=(32, 39, 46), max_new=(11, 13, 9))
+    assert cc.count == 0, f"4th mix recompiled {cc.count} program(s)"
+    stats = ServeEngine.compile_stats()
+    assert stats["spec_draft"] == jit_cache_size(_spec_draft_chunk)
+    assert stats["spec_verify"] == jit_cache_size(_spec_verify_chunk)
+
+
 def test_train_step_compiles_exactly_once():
     cfg = ExperimentConfig(
         rundir="",
@@ -159,3 +217,9 @@ def test_audit_suite_passes_on_cpu_mesh():
     assert fp["n_reduced"] == 0 and fp["n_f32"] > 0 and fp["has_bf16_compute"]
     assert report["decode_while_bodies"], "decode program lost its scan?"
     assert all(n == 0 for n in report["decode_while_bodies"].values())
+    # speculative-verify extensions: collective-free layer loop and the
+    # zero-in-loop-cache-copy census on BOTH serving programs
+    assert report["verify_while_bodies"], "verify program lost its layer scan?"
+    assert all(n == 0 for n in report["verify_while_bodies"].values())
+    assert all(n == 0 for n in report["decode_loop_pool_copies"].values())
+    assert all(n == 0 for n in report["verify_loop_pool_copies"].values())

@@ -33,6 +33,136 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _quick_train(cfg, params, steps: int, seed: int):
+    """Fit the synthetic model to a noisy Markov stream (x_{t+1} =
+    perm[x_t] with prob 0.85, else uniform) for a handful of Adam steps.
+
+    The spec bench needs a model whose early layers AGREE with its full
+    stack — on random init the self-draft's greedy agreement is ~40%
+    (measured, RESULTS.md §5), an artifact of the init, not a property of
+    speculation. A lightly-fitted model is the honest testbed: draft and
+    target both approximate the data distribution, which is exactly the
+    regime speculative decoding is built for."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from midgpt_tpu.models.gpt import GPT
+
+    V = cfg.vocab_size
+    rng = np.random.default_rng(seed + 1)
+    perm = rng.permutation(V)
+
+    def batch(n, T):
+        x = np.zeros((n, T + 1), np.int64)
+        x[:, 0] = rng.integers(0, V, n)
+        for t in range(T):
+            nxt = perm[x[:, t]]
+            noise = rng.random(n) < 0.15
+            x[:, t + 1] = np.where(noise, rng.integers(0, V, n), nxt)
+        return jnp.asarray(x[:, :-1], jnp.int32), jnp.asarray(x[:, 1:], jnp.int32)
+
+    opt = optax.adam(3e-3)
+    ostate = opt.init(params)
+
+    @jax.jit
+    def step(params, ostate, x, y):
+        def loss_fn(p):
+            logits = GPT.apply(cfg, p, x, inference=True).astype(jnp.float32)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, ostate = opt.update(g, ostate)
+        return optax.apply_updates(params, up), ostate, loss
+
+    T = min(64, cfg.block_size)
+    loss = None
+    for _ in range(steps):
+        x, y = batch(8, T)
+        params, ostate, loss = step(params, ostate, x, y)
+    return params, (0.0 if loss is None else float(loss))
+
+
+def _spec_bench(args, cfg, params, cache_dtype, trace, total_new) -> int:
+    """--spec mode: speculative vs plain continuous engine, one JSON line
+    ('serve_spec' profile, analysis/bench_contract.py)."""
+    import jax
+
+    from midgpt_tpu.sampling.serve import ServeEngine
+    from midgpt_tpu.sampling.spec import self_draft
+
+    draft_layers = args.spec_draft_layers or max(1, cfg.n_layer // 3)
+    params, final_loss = _quick_train(cfg, params, args.train_steps, args.seed)
+    draft_cfg, draft_params = self_draft(cfg, params, draft_layers)
+
+    def run(draft):
+        eng = ServeEngine(
+            cfg,
+            params,
+            max_slots=args.max_slots,
+            page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk,
+            decode_chunk=args.decode_chunk,
+            temperature=0.0,
+            cache_dtype=cache_dtype,
+            draft_params=draft_params if draft else None,
+            draft_config=draft_cfg if draft else None,
+            draft_shares_cache=draft,  # self-draft: prefix layers share the pool
+            spec_k_max=args.spec_k,
+        )
+        for prompt, m in trace:
+            eng.submit(prompt, m)
+        t0 = time.perf_counter()
+        eng.run()
+        return eng, time.perf_counter() - t0
+
+    run(draft=False)  # warm the plain prefill/decode shapes
+    _, dt_base = run(draft=False)
+    run(draft=True)  # warm draft prefill + each (k, page) bucket
+    eng_spec, dt_spec = run(draft=True)
+    stats = eng_spec.spec_stats()
+
+    print(
+        json.dumps(
+            {
+                "bench": "serve_spec",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "max_slots": args.max_slots,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "draft_layers": draft_layers,
+                "spec_k_max": args.spec_k,
+                "train_steps": args.train_steps,
+                "train_loss": round(final_loss, 3),
+                "baseline_tok_s": round(total_new / dt_base, 2),
+                "spec_tok_s": round(total_new / dt_spec, 2),
+                "speedup_spec": round(dt_base / dt_spec, 3),
+                "accept_rate": round(stats["accept_rate"], 4),
+                "tokens_per_verify": round(stats["tokens_per_verify"], 3),
+                "hbm_target_cache_bytes": int(eng_spec.cache_hbm_bytes()),
+                # 0: the prefix self-draft rides the target pool's first
+                # n_draft layers — speculation costs no extra cache HBM
+                "hbm_draft_cache_bytes": 0
+                if eng_spec.draft_cache is None
+                else int(
+                    eng_spec.draft_cache.k.nbytes + eng_spec.draft_cache.v.nbytes
+                ),
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-requests", type=int, default=12)
@@ -42,13 +172,36 @@ def main() -> int:
     ap.add_argument("--decode-chunk", type=int, default=8)
     ap.add_argument("--block-size", type=int, default=256)
     ap.add_argument("--vocab-size", type=int, default=512)
-    ap.add_argument("--n-layer", type=int, default=4)
-    ap.add_argument("--n-head", type=int, default=4)
-    ap.add_argument("--n-embd", type=int, default=128)
+    # model shape: None resolves per mode below — the plain serve bench
+    # keeps its r6 4L/128D shape; --spec defaults to 6L/384D, a shape where
+    # the batched verify's GEMM efficiency (vs per-token GEMV decode) is
+    # measurable even on the CPU mesh (RESULTS.md §5)
+    ap.add_argument("--n-layer", type=int, default=None)
+    ap.add_argument("--n-head", type=int, default=None)
+    ap.add_argument("--n-embd", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force CPU with this many virtual devices (0 = native backend)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding bench: quick-train the model "
+                    "on a synthetic Markov stream (an UNTRAINED model has "
+                    "arbitrary draft agreement — speculation claims on it "
+                    "are meaningless), then compare the continuous engine "
+                    "with and without a self-draft on the same trace. Emits "
+                    "the 'serve_spec' JSON profile instead of 'serve'")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="self-draft depth (0 = max(1, n_layer // 3))")
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="spec_k_max for the speculative engine (pow2)")
+    ap.add_argument("--train-steps", type=int, default=60,
+                    help="--spec: quick-train steps before benchmarking")
     args = ap.parse_args()
+    if args.n_layer is None:
+        args.n_layer = 6 if args.spec else 4
+    if args.n_head is None:
+        args.n_head = 6 if args.spec else 4
+    if args.n_embd is None:
+        args.n_embd = 384 if args.spec else 128
 
     import jax
 
@@ -87,6 +240,9 @@ def main() -> int:
         m = int(rng.integers(8, max(9, min(64, S - t0))))
         trace.append((rng.integers(0, cfg.vocab_size, t0, dtype=np.int64), m))
     total_new = sum(m for _, m in trace)
+
+    if args.spec:
+        return _spec_bench(args, cfg, params, cache_dtype, trace, total_new)
 
     def run_continuous():
         eng = ServeEngine(
