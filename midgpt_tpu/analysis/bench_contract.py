@@ -94,6 +94,14 @@ def check_serve_bench(rec: dict) -> tp.List[str]:
             "p50_token_ms": Number,
             "p99_token_ms": Number,
             "ttft_ms_mean": Number,
+            "ttft_ms_p50": Number,
+            "ttft_ms_p95": Number,
+            "req_tok_s_p50": Number,
+            "req_tok_s_p95": Number,
+            "kv_dtype": (str,),
+            "num_pages": (int,),
+            "preemptions": (int,),
+            "cache_hbm_bytes": (int,),
             "hbm_paged_cache_bytes": (int,),
             "hbm_sequential_cache_bytes": (int,),
             "model": (dict,),
@@ -103,12 +111,21 @@ def check_serve_bench(rec: dict) -> tp.List[str]:
     )
     if rec.get("bench") != "serve":
         problems.append(f"field 'bench' is {rec.get('bench')!r}, expected 'serve'")
+    if rec.get("kv_dtype") not in (None, "bf16", "int8"):
+        problems.append(f"field 'kv_dtype' is {rec.get('kv_dtype')!r}")
     if "device_peak_bytes_in_use" not in rec:
         problems.append("missing required field 'device_peak_bytes_in_use'")
     elif rec["device_peak_bytes_in_use"] is not None and not isinstance(
         rec["device_peak_bytes_in_use"], int
     ):
         problems.append("field 'device_peak_bytes_in_use' must be int or null")
+    # int8 runs carry the bf16-comparison block; when present it must be
+    # coherent (the driver keys the capacity claim off these numbers)
+    gmf = rec.get("greedy_match_frac")
+    if gmf is not None and (not isinstance(gmf, Number) or not 0.0 <= gmf <= 1.0):
+        problems.append(f"greedy_match_frac {gmf!r} outside [0, 1]")
+    if rec.get("kv_dtype") == "int8" and "greedy_match_frac" not in rec:
+        problems.append("int8 serve record missing 'greedy_match_frac'")
     return problems
 
 
@@ -132,6 +149,8 @@ def check_serve_spec_bench(rec: dict) -> tp.List[str]:
             "speedup_spec": Number,
             "accept_rate": Number,
             "tokens_per_verify": Number,
+            "kv_dtype": (str,),
+            "cache_hbm_bytes": (int,),
             "hbm_target_cache_bytes": (int,),
             "hbm_draft_cache_bytes": (int,),
             "compile_counts": (dict,),

@@ -330,4 +330,99 @@ def run_audit() -> tp.Dict[str, tp.Any]:
         "pool-sized copies inside the verify layer loop: "
         + str({b: ls[:1] for b, ls in v_copies.items() if ls})
     )
+
+    # Int8 cache mode: the same zero-in-loop-copy property must hold for
+    # the quantized pools AND their f32 scale side buffers (a scale-sized
+    # copy per decode step would silently rebuild the side buffer every
+    # token — small, but a per-token O(pool) cost of exactly the kind the
+    # census exists to catch). Audited on all three serving programs:
+    # decode, draft (the speculative proposer's scan of paged decode steps,
+    # here a 1-layer prefix self-draft against the target pool), verify.
+    from midgpt_tpu.sampling.serve import _spec_draft_chunk
+
+    cache8_abs = jax.eval_shape(
+        lambda: PagedKVCache.init(mc, num_pages=9, page_size=8, dtype=jnp.int8)
+    )
+    pool8_shape = f"s8[{mc.n_layer},{mc.n_head},9,8,{mc.head_dim}]"
+    scale_shape = f"f32[{mc.n_layer},9,{mc.n_head},8]"
+    decode8_hlo = (
+        _serve_decode_chunk.lower(
+            mc,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache8_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            4,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        )
+        .compile()
+        .as_text()
+    )
+    draft_cfg = dataclasses.replace(mc, n_layer=1)
+    draft_abs = jax.eval_shape(
+        lambda k: GPT.init(draft_cfg, k), jax.random.PRNGKey(0)
+    )
+    # prefix self-draft: the draft runs against the TARGET pool's first
+    # layer(s), exactly how ServeEngine(draft_shares_cache=True) calls it
+    draft8_hlo = (
+        _spec_draft_chunk.lower(
+            draft_cfg,
+            draft_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache8_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            K,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        )
+        .compile()
+        .as_text()
+    )
+    verify8_hlo = (
+        _spec_verify_chunk.lower(
+            mc_scan,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((K, B), jnp.int32),
+            jax.ShapeDtypeStruct((K, B, mc.vocab_size), jnp.float32),
+            cache8_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        )
+        .compile()
+        .as_text()
+    )
+    for name, hlo in (
+        ("decode_int8", decode8_hlo),
+        ("draft_int8", draft8_hlo),
+        ("verify_int8", verify8_hlo),
+    ):
+        assert_no_while_body_collectives(hlo)
+        assert while_body_names(hlo), f"{name} program lowered without a loop"
+        for label, shape in (("pool", pool8_shape), ("scale", scale_shape)):
+            copies = while_body_pool_copies(hlo, shape)
+            report[f"{name}_loop_{label}_copies"] = {
+                b: len(ls) for b, ls in copies.items()
+            }
+            assert all(not ls for ls in copies.values()), (
+                f"{label}-sized copies inside the {name} loop: "
+                + str({b: ls[:1] for b, ls in copies.items() if ls})
+            )
     return report

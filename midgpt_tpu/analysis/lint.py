@@ -17,6 +17,10 @@ the CLAUDE.md / RESULTS.md citations live in docs/ANALYSIS.md):
   GC007  bare/broad `except` that swallows failures of checkpoint or
          collective call sites (a silently-dropped save/restore/collective
          is how runs lose state or deadlock half a mesh — robustness PR).
+  GC008  bare `.astype(int8)` with no rounding in sight: the cast TRUNCATES
+         toward zero, so float values quantized that way lose up to a full
+         step of precision and bias toward 0 — quantization must round
+         (ops/quant.py quantize_q8 is the blessed path; int8 KV cache PR).
 
 Scope model: a function is *traced* if it is jit-decorated (including
 `functools.partial(jax.jit, ...)` and `name = jax.jit(fn)` rebinding), a
@@ -50,6 +54,7 @@ RULES: tp.Dict[str, str] = {
     "GC005": "wall clock / numpy RNG reachable from a traced scope",
     "GC006": "parity claim without a reference or pinning-test citation",
     "GC007": "swallowed exception around a checkpoint/collective call site",
+    "GC008": "truncating .astype(int8) cast — quantization must round",
 }
 
 # Default lint roots, relative to the repo root (tests are excluded on
@@ -664,6 +669,56 @@ def _rule_gc007(mod: _Module) -> tp.Iterator[Finding]:
                 )
 
 
+# int8 dtype spellings GC008 recognizes as a quantizing cast target.
+_INT8_DTYPES = frozenset(
+    {"int8", "jnp.int8", "np.int8", "numpy.int8", "jax.numpy.int8"}
+)
+# Calls in the cast's receiver that count as rounding evidence. `clip` is
+# deliberately NOT enough — clip(x, -127, 127).astype(int8) still truncates.
+_ROUNDING_LEAVES = frozenset({"round", "rint", "around", "round_"})
+
+
+def _rule_gc008(mod: _Module) -> tp.Iterator[Finding]:
+    """`x.astype(jnp.int8)` / `x.astype("int8")` with no rounding call in
+    the receiver expression. AST-only, so the source's float-ness cannot be
+    proven — an int-to-int8 narrowing is a legitimate suppression (the
+    justification documents why truncation is safe there)."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "astype"):
+            continue
+        target: tp.Optional[ast.AST] = node.args[0] if node.args else None
+        if target is None:
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    target = kw.value
+        if target is None:
+            continue
+        is_int8 = _dotted(target) in _INT8_DTYPES or (
+            isinstance(target, ast.Constant) and target.value == "int8"
+        )
+        if not is_int8:
+            continue
+        rounded = any(
+            isinstance(sub, ast.Call)
+            and (_call_name(sub) or "").split(".")[-1] in _ROUNDING_LEAVES
+            for sub in ast.walk(f.value)
+        )
+        if not rounded:
+            yield Finding(
+                "GC008",
+                mod.path,
+                node.lineno,
+                node.col_offset,
+                "`.astype(int8)` truncates toward zero — quantization must "
+                "round-to-nearest first (jnp.round / ops/quant.py "
+                "quantize_q8); suppress with justification if the source "
+                "is already integral",
+            )
+
+
 _ALL_RULES = (
     _rule_gc001,
     _rule_gc002,
@@ -672,6 +727,7 @@ _ALL_RULES = (
     _rule_gc005,
     _rule_gc006,
     _rule_gc007,
+    _rule_gc008,
 )
 
 

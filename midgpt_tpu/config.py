@@ -140,6 +140,13 @@ class ExperimentConfig:
     spec_k_max: int = 4
     spec_k_min: int = 1
     spec_adapt: bool = True
+    # Paged-KV-cache storage dtype for the serving engine (sampling/serve.py
+    # ServeEngine cache_dtype; docs/SERVING.md "Quantized KV cache").
+    # 'bf16' (default) stores pages in bf16; 'int8' stores them int8 with
+    # f32 absmax scales in a small side buffer — decode-attention HBM
+    # traffic halves and a byte-budgeted pool admits 2x the pages. Training
+    # is untouched; sample.py --kv_dtype overrides.
+    kv_cache_dtype: str = "bf16"
     debug: bool = False
 
     def __post_init__(self):
@@ -316,6 +323,14 @@ class ExperimentConfig:
         if self.spec_k_min > self.spec_k_max:
             raise ValueError(
                 f"spec_k_min={self.spec_k_min} > spec_k_max={self.spec_k_max}"
+            )
+        if self.kv_cache_dtype not in ("bf16", "int8"):
+            # A typo would silently serve from a bf16 pool the operator
+            # believed was quantized (half the expected page capacity at a
+            # byte budget) — fail at construction like the other enums.
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                "('bf16' or 'int8')"
             )
         if self.data_step_offset < 0:
             # A negative offset would re-sample windows already consumed

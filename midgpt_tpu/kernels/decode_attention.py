@@ -1,4 +1,5 @@
-"""Pallas TPU paged decode attention for the continuous-batching engine.
+"""Pallas TPU paged decode/verify attention for the continuous-batching
+engine, in bf16 and int8-quantized cache modes.
 
 Decode-time attention reads K/V through a per-slot PAGE TABLE instead of a
 contiguous (B, S, ...) cache: physical pages of `page_size` tokens live in a
@@ -20,16 +21,37 @@ Pages at or past a slot's length are predicated off with `pl.when` (compute
 skipped; the block DMA still runs — it reads the reserved sink page or a
 stale page, both masked).
 
-Blocks obey the Mosaic tiling rule (CLAUDE.md): the K/V block's last two
-dims are (page_size, C) with page_size 8-divisible and C spanning the full
-head dim; the q/o blocks span (H, C) fully.
+**Int8 mode** (PagedKVCache int8 storage): pages arrive int8 with f32
+absmax scales in (num_pages, H, page_size) side buffers (one scale per K/V
+vector per head, ops/quant.py). The scale BlockSpec (1, H, page_size)
+fetches exactly one page's scales alongside its int8 page — the trailing
+block dims span the full (H, page_size) array dims, so the layout is
+Mosaic-tileable with no in-kernel transpose — and dequantization happens in
+VMEM before QK^T/PV: HBM only ever moves int8 pages plus the tiny scale
+rows, which is the whole point (decode is HBM-bandwidth-bound; halving
+cache bytes ~halves decode-attention traffic).
 
-Off-TPU the dispatcher uses the XLA gather fallback below, which mirrors the
-contiguous `GPT.decode_step` attention op-for-op (same einsum shapes, same
-mask-then-scale-then-f32-softmax order) so paged decode stays token-exact
-with the single-request engine on the CPU test mesh; the kernel itself runs
-in interpret mode only under its parity test (tests/test_decode_attention.py
-— interpret is too slow for the serving tests' inner loop).
+There are TWO kernels:
+
+  * `paged_attention_kernel` — one query row per slot (plain decode).
+  * `paged_verify_attention_kernel` — T = k+1 query rows per slot with a
+    per-row visible-key count (speculative verification,
+    GPT.verify_step_paged): the multi-row sibling with (H, T, page_size)
+    score tiles and per-(head, row) online-softmax stats. This replaces
+    the gather lowering as the compiled verify path on TPU (it was the
+    named upgrade path of the speculative-decoding PR).
+
+Blocks obey the Mosaic tiling rule (CLAUDE.md): every block's last two
+dims are (8, 128)-divisible or span the full array dim.
+
+Off-TPU the dispatchers use the XLA gather fallbacks below, which mirror
+the contiguous `GPT.decode_step` attention op-for-op (same einsum shapes,
+same mask-then-scale-then-f32-softmax order, dequantizing right after the
+page gather in int8 mode) so paged decode stays token-exact with the
+single-request engine on the CPU test mesh; the kernels themselves run in
+interpret mode only under their parity tests (tests/test_decode_attention.py
+and tests/test_quant_cache.py — interpret is too slow for the serving
+tests' inner loop).
 """
 
 from __future__ import annotations
@@ -44,6 +66,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from midgpt_tpu.kernels.flash_attention import M_INIT, MASK, _interpret
+from midgpt_tpu.ops.quant import dequantize_q8
 
 Array = jax.Array
 
@@ -57,14 +80,16 @@ def _decode_kernel(
     q_ref,  # (1, H, C)
     k_ref,  # (H, 1, page_size, C)
     v_ref,  # (H, 1, page_size, C)
-    o_ref,  # (1, H, C)
-    acc_sc,  # (H, C) f32
-    m_sc,  # (H, _STATS_LANES) f32
-    l_sc,  # (H, _STATS_LANES) f32
-    *,
+    *rest,  # int8 mode: ks_ref, vs_ref (1, H, page_size) f32; then
+    # o_ref (1, H, C), acc_sc (H, C) f32, m_sc/l_sc (H, _STATS_LANES) f32
     scale: float,
     page_size: int,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, acc_sc, m_sc, l_sc = rest
     b, p = pl.program_id(0), pl.program_id(1)
     n_p = pl.num_programs(1)
 
@@ -80,6 +105,12 @@ def _decode_kernel(
     def _compute():
         q = q_ref[0]  # (H, C)
         k = k_ref[:, 0]  # (H, page_size, C)
+        if quantized:
+            # Dequantize in VMEM: the page's f32 scales broadcast over C
+            # (exact — int8 * f32, ops/quant.py), then the same dots as
+            # the bf16 path in f32.
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -92,9 +123,13 @@ def _decode_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         alpha = jnp.exp(m_prev - m_new)
         prob = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
+        if quantized:
+            v = v_ref[:, 0].astype(jnp.float32) * vs_ref[0][:, :, None]
+        else:
+            v = v_ref[:, 0]
         l_new = l_prev * alpha + jnp.sum(prob, axis=-1)
         pv = jax.lax.dot_general(
-            prob.astype(v_ref.dtype), v_ref[:, 0],
+            prob.astype(v.dtype), v,
             (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )  # (H, C)
@@ -115,25 +150,40 @@ def paged_attention_kernel(
     v_pages: Array,
     page_table: Array,  # (B, max_pages) int32
     lengths: Array,  # (B,) int32 — valid tokens per slot (0 = inactive)
+    k_scale: tp.Optional[Array] = None,  # (num_pages, H, page_size) f32
+    v_scale: tp.Optional[Array] = None,
 ) -> Array:
-    """Paged decode attention via the Pallas kernel. Returns (B, H, C)."""
+    """Paged decode attention via the Pallas kernel. Returns (B, H, C).
+    int8 pools require both scale side buffers; bf16 pools take none."""
     B, H, C = q.shape
     _, _, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
     scale = 1.0 / math.sqrt(C)
+    quantized = k_scale is not None
+
+    page_spec = pl.BlockSpec(
+        (H, 1, page_size, C), lambda b, p, pt, ln: (0, pt[b, p], 0, 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # One page's scales per grid step, translated through the same
+        # scalar-prefetched table as its page. Trailing dims (H, page_size)
+        # span the full array dims -> Mosaic-tileable as-is.
+        scale_spec = pl.BlockSpec(
+            (1, H, page_size), lambda b, p, pt, ln: (pt[b, p], 0, 0)
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
-            pl.BlockSpec(
-                (H, 1, page_size, C), lambda b, p, pt, ln: (0, pt[b, p], 0, 0)
-            ),
-            pl.BlockSpec(
-                (H, 1, page_size, C), lambda b, p, pt, ln: (0, pt[b, p], 0, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, C), jnp.float32),
@@ -142,14 +192,40 @@ def paged_attention_kernel(
         ],
     )
     return pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, page_size=page_size),
+        functools.partial(
+            _decode_kernel, scale=scale, page_size=page_size,
+            quantized=quantized,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, C), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=_interpret(),
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pages, v_pages)
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
+
+
+def _gather_pages(
+    pages: Array,  # (H, num_pages, page_size, C)
+    scales: tp.Optional[Array],  # (num_pages, H, page_size) f32 | None
+    page_table: Array,  # (B, max_pages) int32
+    out_dtype,
+) -> Array:
+    """Gather every slot's pages contiguous -> (B, H, S, C), dequantizing
+    right after the gather in int8 mode (the CPU sibling of the kernels'
+    in-VMEM dequant; ops/quant.py — exact, so gather and kernel read
+    identical values from the same pool)."""
+    H, _, page_size, C = pages.shape
+    B, max_pages = page_table.shape
+    S = max_pages * page_size
+    flat = page_table.reshape(-1)
+    g = jnp.take(pages, flat, axis=1)  # (H, B*max_pages, page_size, C)
+    g = g.reshape(H, B, S, C).transpose(1, 0, 2, 3)  # (B, H, S, C)
+    if scales is None:
+        return g
+    sg = jnp.take(scales, flat, axis=0)  # (B*max_pages, H, page_size)
+    sg = sg.reshape(B, max_pages, H, page_size).transpose(0, 2, 1, 3)
+    return dequantize_q8(g, sg.reshape(B, H, S)).astype(out_dtype)
 
 
 def paged_attention_gather(
@@ -158,20 +234,19 @@ def paged_attention_gather(
     v_pages: Array,
     page_table: Array,  # (B, max_pages) int32
     lengths: Array,  # (B,) int32
+    k_scale: tp.Optional[Array] = None,
+    v_scale: tp.Optional[Array] = None,
 ) -> Array:
-    """XLA fallback: gather each slot's pages contiguous, then run the exact
-    attention ops of the contiguous `GPT.decode_step` (same einsum shapes,
-    -inf mask BEFORE the 1/sqrt(C)-scaled f32 softmax) so paged and
-    contiguous decode agree token-for-token on CPU. O(B * max_pages) page
-    reads per call — the kernel above is the O(used-length) path on TPU."""
+    """XLA fallback: gather each slot's pages contiguous (dequantized in
+    int8 mode), then run the exact attention ops of the contiguous
+    `GPT.decode_step` (same einsum shapes, -inf mask BEFORE the
+    1/sqrt(C)-scaled f32 softmax) so paged and contiguous decode agree
+    token-for-token on CPU. O(B * max_pages) page reads per call — the
+    kernel above is the O(used-length) path on TPU."""
     B, H, C = q.shape
-    _, _, page_size, _ = k_pages.shape
-    max_pages = page_table.shape[1]
-    S = max_pages * page_size
-    flat = page_table.reshape(-1)
-    kg = jnp.take(k_pages, flat, axis=1)  # (H, B*max_pages, page_size, C)
-    kg = kg.reshape(H, B, S, C).transpose(1, 0, 2, 3)  # (B, H, S, C)
-    vg = jnp.take(v_pages, flat, axis=1).reshape(H, B, S, C).transpose(1, 0, 2, 3)
+    S = page_table.shape[1] * k_pages.shape[2]
+    kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
+    vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
     scores = jnp.einsum("bhqc,bhkc->bhqk", q[:, :, None], kg)  # (B, H, 1, S)
     valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
     scores = jnp.where(valid, scores, float("-inf"))
@@ -188,6 +263,8 @@ def paged_attention(
     page_table: Array,
     lengths: Array,
     impl: str = "auto",
+    k_scale: tp.Optional[Array] = None,
+    v_scale: tp.Optional[Array] = None,
 ) -> Array:
     """Dispatch: Pallas kernel on TPU, XLA gather elsewhere (interpret mode
     is orders of magnitude too slow for the serving loop — same policy as
@@ -195,10 +272,189 @@ def paged_attention(
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
-        return paged_attention_kernel(q, k_pages, v_pages, page_table, lengths)
+        return paged_attention_kernel(
+            q, k_pages, v_pages, page_table, lengths, k_scale, v_scale
+        )
     if impl == "gather":
-        return paged_attention_gather(q, k_pages, v_pages, page_table, lengths)
+        return paged_attention_gather(
+            q, k_pages, v_pages, page_table, lengths, k_scale, v_scale
+        )
     raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+# ----------------------------------------------------------------------
+# Multi-row paged verify attention (speculative decoding)
+# ----------------------------------------------------------------------
+
+
+def _verify_kernel(
+    pt_ref,  # (B, max_pages) int32 scalar-prefetch: page table
+    cnt_ref,  # (B, T) int32 scalar-prefetch: visible keys per row
+    q_ref,  # (1, H, T, C) — head-major (transposed once outside)
+    k_ref,  # (H, 1, page_size, C)
+    v_ref,  # (H, 1, page_size, C)
+    *rest,  # int8 mode: ks_ref, vs_ref (1, H, page_size) f32; then
+    # o_ref (1, H, T, C), acc_sc (H, T, C) f32,
+    # m_sc/l_sc (H, T, _STATS_LANES) f32
+    scale: float,
+    page_size: int,
+    n_rows: int,
+    quantized: bool,
+):
+    """The decode kernel's online-softmax page sweep, widened to T = k+1
+    query rows per slot: score tiles are (H, T, page_size), the running
+    m/l statistics carry a row axis, and each row t masks to its OWN
+    visible-key count cnt_ref[b, t] (the caller passes lengths + t + 1,
+    which is what makes the speculative chunk causal through the page
+    table — GPT.verify_step_paged). Counts are nondecreasing in t, so the
+    page sweep runs to the LAST row's count and earlier rows simply mask."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, acc_sc, m_sc, l_sc = rest
+    b, p = pl.program_id(0), pl.program_id(1)
+    n_p = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, M_INIT)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # Per-row counts from SMEM, assembled by a static unroll over the
+    # (small, static) row count; the sweep bound is the last row's count.
+    counts = jnp.stack([cnt_ref[b, t] for t in range(n_rows)])  # (T,)
+
+    @pl.when(p * page_size < cnt_ref[b, n_rows - 1])
+    def _compute():
+        q = q_ref[0]  # (H, T, C)
+        k = k_ref[:, 0]  # (H, page_size, C)
+        if quantized:
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (H, T, page_size) f32
+        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(col < counts[None, :, None], s, MASK)
+
+        m_prev = m_sc[:, :, 0]  # (H, T)
+        l_prev = l_sc[:, :, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        prob = jnp.exp(s - m_new[:, :, None])  # masked entries underflow to 0
+        if quantized:
+            v = v_ref[:, 0].astype(jnp.float32) * vs_ref[0][:, :, None]
+        else:
+            v = v_ref[:, 0]
+        l_new = l_prev * alpha + jnp.sum(prob, axis=-1)
+        pv = jax.lax.dot_general(
+            prob.astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (H, T, C)
+        acc_sc[:] = acc_sc[:] * alpha[:, :, None] + pv
+        m_sc[:] = jnp.broadcast_to(m_new[:, :, None], m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new[:, :, None], l_sc.shape)
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = l_sc[:, :, 0]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_sc[:] / safe_l[:, :, None]).astype(o_ref.dtype)
+
+
+def paged_verify_attention_kernel(
+    q: Array,  # (B, T, H, C)
+    k_pages: Array,  # (H, num_pages, page_size, C)
+    v_pages: Array,
+    page_table: Array,  # (B, max_pages) int32
+    counts: Array,  # (B, T) int32 — keys visible to row t of slot b
+    k_scale: tp.Optional[Array] = None,
+    v_scale: tp.Optional[Array] = None,
+) -> Array:
+    """Multi-row paged attention via the Pallas verify kernel. Returns
+    (B, T, H, C). q is transposed head-major ONCE outside the kernel (a
+    single small XLA transpose per verify forward) so the kernel works in
+    the pool's native (H, ...) layout with no in-kernel transposes."""
+    B, T, H, C = q.shape
+    _, _, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    scale = 1.0 / math.sqrt(C)
+    quantized = k_scale is not None
+    q_hm = q.transpose(0, 2, 1, 3)  # (B, H, T, C)
+
+    page_spec = pl.BlockSpec(
+        (H, 1, page_size, C), lambda b, p, pt, cnt: (0, pt[b, p], 0, 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, H, T, C), lambda b, p, pt, cnt: (b, 0, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q_hm, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, H, page_size), lambda b, p, pt, cnt: (pt[b, p], 0, 0)
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, H, T, C), lambda b, p, pt, cnt: (b, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((H, T, C), jnp.float32),
+            pltpu.VMEM((H, T, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((H, T, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel, scale=scale, page_size=page_size, n_rows=T,
+            quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, T, C), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), counts.astype(jnp.int32), *operands)
+    return out.transpose(0, 2, 1, 3)  # (B, T, H, C)
+
+
+def paged_verify_attention_gather(
+    q: Array,  # (B, T, H, C)
+    k_pages: Array,
+    v_pages: Array,
+    page_table: Array,
+    counts: Array,  # (B, T) int32
+    k_scale: tp.Optional[Array] = None,
+    v_scale: tp.Optional[Array] = None,
+) -> Array:
+    """XLA gather lowering of the multi-row verify attention: pages
+    gathered contiguous once (dequantized in int8 mode, like
+    prefill_paged_chunk), then per-row count masks over the shared buffer.
+    Same mask-then-scale-then-f32-softmax order as
+    `paged_attention_gather`, so speculative greedy verify stays
+    token-exact with plain paged decode (pinned by tests/test_spec.py)."""
+    B, T, H, C = q.shape
+    S = page_table.shape[1] * k_pages.shape[2]
+    kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
+    vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+    scores = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg)
+    valid = jnp.arange(S)[None, None, None, :] < counts[:, None, :, None]
+    scores = jnp.where(valid, scores, float("-inf"))
+    probs = jax.nn.softmax(
+        scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+    ).astype(q.dtype)
+    return jnp.einsum("bhtk,bhkc->bthc", probs, vg)  # (B, T, H, C)
 
 
 def paged_verify_attention(
@@ -208,39 +464,27 @@ def paged_verify_attention(
     page_table: Array,  # (B, max_pages) int32
     counts: Array,  # (B, T) int32 — keys visible to row t of slot b
     impl: str = "auto",
+    k_scale: tp.Optional[Array] = None,
+    v_scale: tp.Optional[Array] = None,
 ) -> Array:
     """Batched multi-row paged attention for speculative verification
     (GPT.verify_step_paged): every slot scores its k+1 candidate positions
     against its own pages in ONE call. Row t of slot b attends to
     counts[b, t] keys — the caller passes lengths[b] + t + 1, which makes
     the chunk causal through the cache: all rows' K/V are written before
-    the gather, and the per-row count hides the later rows.
+    the read, and the per-row count hides the later rows.
 
-    Gather lowering only for now (pages gathered contiguous once, like
-    prefill_paged_chunk): the one-query-row online-softmax shape of the
-    Pallas decode kernel above does not fit a (B, T) query block, so a
-    multi-row verify kernel is the TPU upgrade path (docs/SERVING.md) —
-    'auto'/'gather' both take this path, 'kernel' fails loudly instead of
-    silently falling back. Same mask-then-scale-then-f32-softmax order as
-    `paged_attention_gather`, so speculative greedy verify stays
-    token-exact with plain paged decode (pinned by tests/test_spec.py)."""
+    Dispatch mirrors `paged_attention`: the Pallas multi-row kernel on TPU
+    (the compiled verify path, bf16 and int8 — interpret-mode parity in
+    tests/test_quant_cache.py), the XLA gather lowering elsewhere."""
+    if impl == "auto":
+        impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
-        raise NotImplementedError(
-            "no Pallas verify kernel yet — multi-row paged attention runs "
-            "the gather lowering (docs/SERVING.md upgrade path)"
+        return paged_verify_attention_kernel(
+            q, k_pages, v_pages, page_table, counts, k_scale, v_scale
         )
-    B, T, H, C = q.shape
-    _, _, page_size, _ = k_pages.shape
-    max_pages = page_table.shape[1]
-    S = max_pages * page_size
-    flat = page_table.reshape(-1)
-    kg = jnp.take(k_pages, flat, axis=1)  # (H, B*max_pages, page_size, C)
-    kg = kg.reshape(H, B, S, C).transpose(1, 0, 2, 3)  # (B, H, S, C)
-    vg = jnp.take(v_pages, flat, axis=1).reshape(H, B, S, C).transpose(1, 0, 2, 3)
-    scores = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg)
-    valid = jnp.arange(S)[None, None, None, :] < counts[:, None, :, None]
-    scores = jnp.where(valid, scores, float("-inf"))
-    probs = jax.nn.softmax(
-        scores.astype(jnp.float32) / math.sqrt(C), axis=-1
-    ).astype(q.dtype)
-    return jnp.einsum("bhtk,bhkc->bthc", probs, vg)  # (B, T, H, C)
+    if impl == "gather":
+        return paged_verify_attention_gather(
+            q, k_pages, v_pages, page_table, counts, k_scale, v_scale
+        )
+    raise ValueError(f"unknown paged verify attention impl {impl!r}")

@@ -39,6 +39,7 @@ from jax.ad_checkpoint import checkpoint_name
 from midgpt_tpu.ops.attention import multihead_attention
 from midgpt_tpu.ops.dropout import dropout
 from midgpt_tpu.ops.norms import head_layer_norm, rms_norm
+from midgpt_tpu.ops.quant import dequantize_q8, quantize_q8
 from midgpt_tpu.ops.rope import apply_rope, apply_rope_bthc, rope_table
 from midgpt_tpu.utils.pytree import pytree_dataclass
 
@@ -248,10 +249,30 @@ class PagedKVCache:
     page_size must be a multiple of 8 and head_dim a multiple of 128 — or
     span the full dim — for the Mosaic decode kernel's BlockSpec tiling
     (kernels/decode_attention.py); the XLA gather fallback has no such
-    constraint."""
+    constraint.
+
+    **Int8 storage mode** (dtype=jnp.int8): K/V pages are stored int8 with
+    f32 absmax scales in small side buffers `k_scale`/`v_scale` of shape
+    (n_layer, num_pages, n_head, page_size) — one scale per written K/V
+    vector per head (ops/quant.py: a page fills incrementally through the
+    scatter write paths, so scale granularity cannot be coarser than a
+    position without requantizing already-written columns). The layout
+    puts (n_head, page_size) last so the decode kernel's per-page scale
+    block (1, n_head, page_size) spans both trailing dims — Mosaic-tiling
+    clean with no in-kernel transpose. Decode-attention HBM traffic halves
+    vs bf16 and pages-per-byte doubles; the side buffers add 4/head_dim
+    (~3% at C=128) on top. Rollback interacts exactly like the pools:
+    freeing a page orphans its scale entries too, and they are rewritten
+    before they are next read (the write-before-read invariant,
+    docs/SERVING.md). In bf16 mode both scale fields are None."""
 
     k: Array  # (n_layer, n_head, num_pages, page_size, head_dim)
     v: Array
+    # int8 mode only: f32 absmax scales, (n_layer, num_pages, n_head,
+    # page_size); None in bf16 mode (the leaves simply vanish from the
+    # pytree, so bf16 programs are byte-identical to the pre-int8 repo).
+    k_scale: tp.Optional[Array] = None
+    v_scale: tp.Optional[Array] = None
 
     @staticmethod
     def init(
@@ -267,7 +288,30 @@ class PagedKVCache:
             page_size,
             config.head_dim,
         )
+        if jnp.dtype(dtype) == jnp.int8:
+            sshape = (config.n_layer, num_pages, config.n_head, page_size)
+            return PagedKVCache(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(sshape, jnp.float32),
+                v_scale=jnp.zeros(sshape, jnp.float32),
+            )
         return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @staticmethod
+    def page_bytes(config: "GPTConfig", page_size: int, dtype) -> int:
+        """K+V bytes of ONE page across all layers/heads — the unit the
+        byte-budgeted pool sizing divides by (sampling/serve.py
+        `pool_hbm_bytes`). Deliberately excludes the int8 scale side
+        buffers: the budget governs the page pools (what doubles), and the
+        +4/head_dim side buffer is reported separately via
+        ServeEngine.cache_hbm_bytes() so drivers see the true spend."""
+        per_tok = config.n_layer * config.n_head * config.head_dim
+        return 2 * per_tok * page_size * jnp.dtype(dtype).itemsize
+
+    @property
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
 
     @property
     def page_size(self) -> int:
@@ -276,6 +320,69 @@ class PagedKVCache:
     @property
     def num_pages(self) -> int:
         return self.k.shape[2]
+
+
+def _paged_write(
+    pool: Array,  # (L, H, P, ps, C) — K or V pages
+    scales: tp.Optional[Array],  # (L, P, H, ps) f32, or None (bf16 mode)
+    i: Array,  # () int — layer index
+    write_pages: Array,  # (...,) int32 — physical page per written position
+    offs: Array,  # (...,) int32 — in-page offset per written position
+    val: Array,  # (..., H, C) — the K/V vectors to store
+) -> tp.Tuple[Array, tp.Optional[Array]]:
+    """ONE column scatter into the paged pool, quantizing iff `scales` is
+    present — the single write path all three paged forwards share
+    (decode_step_paged / prefill_paged_chunk / verify_step_paged), so the
+    int8 and bf16 modes cannot drift structurally.
+
+    The pool scatter is the advanced-indexing shape that lowers to an
+    in-place aliasing scatter inside donated loop carries (i/write_pages/
+    offs are the advanced indices, H and C ride as slices — the
+    zero-in-loop-pool-copy pin, tests/test_sampling.py and
+    tests/test_quant_cache.py). The scale scatter has the same advanced
+    index tuple over its (L, P, H, ps) layout, so it aliases identically;
+    out-of-range write_pages (inactive slots, pad positions) drop BOTH
+    writes via XLA oob-scatter semantics."""
+    if scales is None:
+        pool = pool.at[i, :, write_pages, offs, :].set(val.astype(pool.dtype))
+        return pool, None
+    q, s = quantize_q8(val)  # (..., H, C) int8, (..., H) f32
+    pool = pool.at[i, :, write_pages, offs, :].set(q)
+    scales = scales.at[i, write_pages, :, offs].set(s)
+    return pool, scales
+
+
+def _layer_pages(
+    pool: Array, scales: tp.Optional[Array], i: Array
+) -> tp.Tuple[Array, tp.Optional[Array]]:
+    """Layer i's pages (H, P, ps, C) and scales (P, H, ps) | None."""
+    kp = jax.lax.dynamic_index_in_dim(pool, i, axis=0, keepdims=False)
+    sp = (
+        None
+        if scales is None
+        else jax.lax.dynamic_index_in_dim(scales, i, axis=0, keepdims=False)
+    )
+    return kp, sp
+
+
+def _gather_layer_kv(
+    pool_layer: Array,  # (H, P, ps, C)
+    scales_layer: tp.Optional[Array],  # (P, H, ps) f32 | None
+    page_rows: Array,  # (MP,) int32 — one slot's logical->physical pages
+    out_dtype,
+) -> Array:
+    """Gather one slot's pages contiguous -> (H, MP*ps, C), dequantizing
+    after the gather in int8 mode (the CPU sibling of the kernel's in-VMEM
+    dequant). Used by prefill's inline attention; the batched variant
+    lives in kernels/decode_attention.py."""
+    H, _, ps, C = pool_layer.shape
+    S = page_rows.shape[0] * ps
+    g = jnp.take(pool_layer, page_rows, axis=1).reshape(H, S, C)
+    if scales_layer is None:
+        return g
+    sg = jnp.take(scales_layer, page_rows, axis=0)  # (MP, H, ps)
+    sg = sg.transpose(1, 0, 2).reshape(H, S)
+    return dequantize_q8(g, sg).astype(out_dtype)
 
 
 def _remat_policy(name: str):
@@ -996,39 +1103,46 @@ class GPT:
         positions = pos[:, None]  # (B, 1) — per-slot absolute positions
 
         def block_fn(carry, block_and_idx):
-            x, ck_all, cv_all = carry  # pools (L, H, P, ps, C)
+            x, ck_all, cv_all, cks_all, cvs_all = carry  # pools (L,H,P,ps,C)
             block, i = block_and_idx
             h = rms_norm(x)
             q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
             q = apply_rope_positions(q, sin, cos, positions, style=config.rope_style)
             k = apply_rope_positions(k, sin, cos, positions, style=config.rope_style)
             q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]  # (B, H, C)
-            # Advanced-indexing scatter: one (B,)-indexed column write per
-            # pool — i/write_pages/offs are the advanced indices (result
-            # dims (B, H, C) lead), the H and C axes ride as slices. In the
-            # decode loop carry this lowers to an in-place scatter, not a
-            # pool copy (pinned).
-            ck_all = ck_all.at[i, :, write_pages, offs, :].set(
-                k1.astype(ck_all.dtype)
+            # Advanced-indexing scatter (quantizing in int8 mode): one
+            # (B,)-indexed column write per pool — i/write_pages/offs are
+            # the advanced indices (result dims (B, H, C) lead), the H and
+            # C axes ride as slices. In the decode loop carry this lowers
+            # to an in-place scatter, not a pool copy (pinned) — scale
+            # side buffers included (_paged_write).
+            ck_all, cks_all = _paged_write(
+                ck_all, cks_all, i, write_pages, offs, k1
             )
-            cv_all = cv_all.at[i, :, write_pages, offs, :].set(
-                v1.astype(cv_all.dtype)
+            cv_all, cvs_all = _paged_write(
+                cv_all, cvs_all, i, write_pages, offs, v1
             )
-            kp = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
-            vp = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
+            kp, ksp = _layer_pages(ck_all, cks_all, i)
+            vp, vsp = _layer_pages(cv_all, cvs_all, i)
             att = paged_attention(
-                q1, kp, vp, page_table, attn_counts, impl=attn_impl
+                q1, kp, vp, page_table, attn_counts, impl=attn_impl,
+                k_scale=ksp, v_scale=vsp,
             )  # (B, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att[:, None])
-            return (x, ck_all, cv_all), None
+            return (x, ck_all, cv_all, cks_all, cvs_all), None
 
         carry = GPT._decode_layer_loop(
-            config, block_fn, (x, cache.k, cache.v), params.blocks
+            config,
+            block_fn,
+            (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            params.blocks,
         )
-        x, k_new, v_new = carry
+        x, k_new, v_new, ks_new, vs_new = carry
         x = rms_norm(x, eps=1e-5)
         logits = jnp.einsum("btd,vd->btv", x, params.lm_head)[:, 0]
-        return logits, PagedKVCache(k=k_new, v=v_new)
+        return logits, PagedKVCache(
+            k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
+        )
 
     @staticmethod
     def verify_step_paged(
@@ -1088,7 +1202,7 @@ class GPT:
         sin, cos = rope_table(C, config.block_size)
 
         def block_fn(carry, block_and_idx):
-            x, ck_all, cv_all = carry  # pools (L, H, P, ps, C)
+            x, ck_all, cv_all, cks_all, cvs_all = carry  # pools (L,H,P,ps,C)
             block, i = block_and_idx
             h = rms_norm(x)
             q, k, v = GPT._project_qkv(config, block, h)  # (B, K1, H, C)
@@ -1096,28 +1210,35 @@ class GPT:
             k = apply_rope_positions(k, sin, cos, positions, style=config.rope_style)
             # (B, K1)-indexed column scatter: i scalar x write_pages x offs
             # broadcast to (B, K1) result dims, H and C ride as slices — the
-            # same in-place-aliasing shape as the decode/prefill scatters.
-            ck_all = ck_all.at[i, :, write_pages, offs, :].set(
-                k.astype(ck_all.dtype)
+            # same in-place-aliasing shape as the decode/prefill scatters
+            # (quantizing in int8 mode, scale buffers riding along).
+            ck_all, cks_all = _paged_write(
+                ck_all, cks_all, i, write_pages, offs, k
             )
-            cv_all = cv_all.at[i, :, write_pages, offs, :].set(
-                v.astype(cv_all.dtype)
+            cv_all, cvs_all = _paged_write(
+                cv_all, cvs_all, i, write_pages, offs, v
             )
-            kp = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
-            vp = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
+            kp, ksp = _layer_pages(ck_all, cks_all, i)
+            vp, vsp = _layer_pages(cv_all, cvs_all, i)
             att = paged_verify_attention(
-                q, kp, vp, page_table, attn_counts, impl=attn_impl
+                q, kp, vp, page_table, attn_counts, impl=attn_impl,
+                k_scale=ksp, v_scale=vsp,
             )  # (B, K1, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att.astype(x.dtype))
-            return (x, ck_all, cv_all), None
+            return (x, ck_all, cv_all, cks_all, cvs_all), None
 
         carry = GPT._decode_layer_loop(
-            config, block_fn, (x, cache.k, cache.v), params.blocks
+            config,
+            block_fn,
+            (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            params.blocks,
         )
-        x, k_new, v_new = carry
+        x, k_new, v_new, ks_new, vs_new = carry
         x = rms_norm(x, eps=1e-5)
         logits = jnp.einsum("btd,vd->btv", x, params.lm_head)
-        return logits, PagedKVCache(k=k_new, v=v_new)
+        return logits, PagedKVCache(
+            k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
+        )
 
     @staticmethod
     def prefill_paged_chunk(
@@ -1168,7 +1289,7 @@ class GPT:
         attn_counts = jnp.minimum(positions, start + n_valid - 1) + 1  # (T_c,)
 
         def block_fn(carry, block_and_idx):
-            x, ck_all, cv_all = carry
+            x, ck_all, cv_all, cks_all, cvs_all = carry
             block, i = block_and_idx
             h = rms_norm(x)
             q, k, v = GPT._project_qkv(config, block, h)  # (1, T_c, H, C)
@@ -1176,22 +1297,24 @@ class GPT:
             kr = apply_rope_bthc(k, sin, cos, positions, style=config.rope_style)
             # kr[0]/v[0] are (T_c, H, C) — the advanced-index scatter's
             # broadcast dims (i scalar x write_pages x offs -> (T_c,)) lead,
-            # H and C ride as slices, so that's the update shape verbatim.
-            ck_all = ck_all.at[i, :, write_pages, offs, :].set(
-                kr[0].astype(ck_all.dtype)
+            # H and C ride as slices, so that's the update shape verbatim
+            # (quantized with per-vector scales in int8 mode).
+            ck_all, cks_all = _paged_write(
+                ck_all, cks_all, i, write_pages, offs, kr[0]
             )
-            cv_all = cv_all.at[i, :, write_pages, offs, :].set(
-                v[0].astype(cv_all.dtype)
+            cv_all, cvs_all = _paged_write(
+                cv_all, cvs_all, i, write_pages, offs, v[0]
             )
-            kp = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
-            vp = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
-            # Gather the slot's pages contiguous ONCE; every chunk row
-            # attends to the same buffer under its own length mask (same
+            kp, ksp = _layer_pages(ck_all, cks_all, i)
+            vp, vsp = _layer_pages(cv_all, cvs_all, i)
+            # Gather the slot's pages contiguous ONCE (dequantizing after
+            # the gather in int8 mode); every chunk row attends to the same
+            # buffer under its own length mask (same
             # mask-then-scale-then-f32-softmax order as decode_step).
             H = config.n_head
-            S = page_table.shape[1] * ps
-            kg = jnp.take(kp, page_table[0], axis=1).reshape(H, S, C)
-            vg = jnp.take(vp, page_table[0], axis=1).reshape(H, S, C)
+            kg = _gather_layer_kv(kp, ksp, page_table[0], x.dtype)
+            vg = _gather_layer_kv(vp, vsp, page_table[0], x.dtype)
+            S = kg.shape[1]
             scores = jnp.einsum("thc,hsc->hts", qr[0].astype(kg.dtype), kg)
             ok = jnp.arange(S)[None, None, :] < attn_counts[None, :, None]
             scores = jnp.where(ok, scores, float("-inf"))
@@ -1200,15 +1323,20 @@ class GPT:
             ).astype(kg.dtype)
             att = jnp.einsum("hts,hsc->thc", probs, vg)  # (T_c, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att[None].astype(x.dtype))
-            return (x, ck_all, cv_all), None
+            return (x, ck_all, cv_all, cks_all, cvs_all), None
 
         carry = GPT._decode_layer_loop(
-            config, block_fn, (x, cache.k, cache.v), params.blocks
+            config,
+            block_fn,
+            (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            params.blocks,
         )
-        x, k_new, v_new = carry
+        x, k_new, v_new, ks_new, vs_new = carry
         x = rms_norm(x, eps=1e-5)
         logits = jnp.einsum("btd,vd->btv", x, params.lm_head)
-        return logits, PagedKVCache(k=k_new, v=v_new)
+        return logits, PagedKVCache(
+            k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
+        )
 
     @staticmethod
     def count_params(params: GPTParams) -> int:

@@ -1,0 +1,69 @@
+"""Symmetric int8 quantization for the paged KV cache.
+
+TPU decode is HBM-bandwidth-bound (the FlashAttention IO argument,
+PAPERS.md), and the paged K/V pool is the dominant recurring HBM stream of
+the serving engine: every decode step re-reads every cached key and value.
+Storing the pool as int8 with f32 absmax scales halves that traffic vs
+bf16 and doubles pages-per-byte, at the cost of one rounding step per
+write and one multiply per read (both negligible next to the QK^T/PV
+matmuls). Scale granularity is per written K/V vector per head — one f32
+per (head, position) quantized over the head_dim axis — which is the
+finest granularity the scatter write paths admit (a page fills
+incrementally, so a genuinely per-page scale would have to requantize
+previously written columns, destroying the zero-in-loop-pool-copy
+aliasing property the serving engine is built on; see
+models/gpt.py PagedKVCache).
+
+Quantization is symmetric absmax with round-to-nearest:
+
+    scale = max(|x|) / 127        (over the head_dim axis)
+    q     = clip(round(x / scale), -127, 127)  as int8
+    x~    = q * scale             (dequantization, exact in f32)
+
+An all-zero vector stores scale 0 and q 0, so it dequantizes to exact
+zeros (the division guards against 0/0). -128 is never produced, so the
+code space is symmetric and |x~ - x| <= scale / 2 elementwise.
+
+The write paths (GPT.decode_step_paged / prefill_paged_chunk /
+verify_step_paged) quantize on scatter; the read paths dequantize either
+inside the Pallas kernels (kernels/decode_attention.py — int8 pages and
+scales are fetched into VMEM and widened there, so HBM only ever moves
+int8) or right after the XLA page gather on CPU.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# 127, not 128: symmetric code space — q = -128 can never round out of
+# clip(-127, 127), so dequantization never overshoots the recorded absmax.
+Q8_MAX = 127.0
+
+
+def quantize_q8(x: Array) -> tp.Tuple[Array, Array]:
+    """Quantize over the LAST axis: x (..., C) -> (q int8 (..., C), scale
+    f32 (...)). Round-to-nearest (GC008: a bare truncating cast is exactly
+    the bug this helper exists to prevent)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / Q8_MAX
+    safe = jnp.where(scale > 0.0, scale, 1.0)  # all-zero vector -> q = 0
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -Q8_MAX, Q8_MAX).astype(
+        jnp.int8
+    )
+    return q, scale
+
+
+def dequantize_q8(q: Array, scale: Array) -> Array:
+    """Exact inverse map: q (..., C) int8, scale (...) f32 -> f32 (..., C).
+
+    int8 * f32 is exact in f32 (both operands are exactly representable),
+    so every reader that dequantizes the same (q, scale) pair — Pallas
+    kernel, XLA gather fallback, test oracle — sees bit-identical values.
+    """
+    return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)

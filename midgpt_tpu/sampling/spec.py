@@ -24,7 +24,13 @@ makes speculative greedy decode token-identical to plain greedy decode
 
 The engine wiring (draft rounds interleaved with verify rounds, per-slot
 adaptive k, page-aligned cache rollback) lives in sampling/serve.py;
-docs/SERVING.md documents the invariants.
+docs/SERVING.md documents the invariants. With the int8 quantized cache
+(PagedKVCache int8 storage) the rollback story is unchanged: freeing a
+tail page orphans its f32 scale entries together with its int8 columns
+(both are indexed by physical page), and greedy speculative serving stays
+token-identical to plain paged decode on the SAME quantized pool — the
+draft's prefix-layer writes and the verify rewrite quantize identical
+values (pinned by tests/test_quant_cache.py).
 """
 
 from __future__ import annotations

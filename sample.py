@@ -49,6 +49,14 @@ def main() -> None:
         "Implies --engine=continuous",
     )
     parser.add_argument(
+        "--kv_dtype", choices=("bf16", "int8"), default=None,
+        help="paged KV cache storage dtype for the continuous engine "
+        "(docs/SERVING.md 'Quantized KV cache'): int8 halves cache HBM "
+        "and decode-attention traffic. Default: the checkpoint config's "
+        "kv_cache_dtype. Implies --engine=continuous (the batch engine's "
+        "contiguous cache has no quantized mode)",
+    )
+    parser.add_argument(
         "--draft_ckpt", type=str, default=None,
         help="speculative decoding with a SEPARATE draft checkpoint dir "
         "(its own config.json; must share vocab and block_size). Implies "
@@ -59,6 +67,8 @@ def main() -> None:
         parser.error("--draft_ckpt and --spec_layers are mutually exclusive")
     if args.draft_ckpt is not None or args.spec_layers:
         args.engine = "continuous"  # speculation lives in the serve engine
+    if args.kv_dtype == "int8":
+        args.engine = "continuous"  # the quantized cache is paged-only
 
     import jax
 
@@ -168,10 +178,14 @@ def main() -> None:
             )
             draft_shares_cache = True  # prefix layers ride the target pool
             print(f"self-draft: first {spec_layers}/{model_cfg.n_layer} layers")
+        kv_dtype = (
+            config.kv_cache_dtype if args.kv_dtype is None else args.kv_dtype
+        )
         eng = ServeEngine(
             model_cfg,
             params,
             max_slots=args.max_slots,
+            cache_dtype=kv_dtype,
             temperature=args.temperature,
             top_k=args.top_k,
             top_p=args.top_p,

@@ -94,6 +94,15 @@ def flush(mngr, state):
 """,
         4,
     ),
+    "GC008": (
+        """\
+import jax.numpy as jnp
+
+def quantize(x, scale):
+    return (x / scale).astype(jnp.int8)
+""",
+        4,
+    ),
 }
 
 
@@ -158,6 +167,32 @@ def run(buf, xs):
     )
     active, _ = lint_source(bad, "bad.py")
     assert [f.rule for f in active] == ["GC004"]
+
+
+def test_gc008_accepts_rounded_cast_and_string_dtype():
+    """The blessed quantization shape — round (possibly under clip) before
+    the int8 cast — passes; a truncating cast via the STRING dtype
+    spelling is still caught."""
+    ok = """\
+import jax.numpy as jnp
+
+def quantize(x, scale):
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+"""
+    active, _ = lint_source(ok, "ok.py")
+    assert active == []
+    bad = 'def f(x):\n    return x.astype("int8")\n'
+    active, _ = lint_source(bad, "bad.py")
+    assert [(f.rule, f.line) for f in active] == [("GC008", 2)]
+    # clip alone is NOT rounding evidence (it still truncates)
+    clip_only = """\
+import jax.numpy as jnp
+
+def f(x):
+    return jnp.clip(x, -127, 127).astype(jnp.int8)
+"""
+    active, _ = lint_source(clip_only, "clip.py")
+    assert [f.rule for f in active] == ["GC008"]
 
 
 def test_gc006_accepts_reference_or_test_citation():

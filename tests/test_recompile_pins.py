@@ -152,6 +152,54 @@ def test_spec_mixes_one_draft_and_verify_program_per_k_bucket(params):
     assert stats["spec_verify"] == jit_cache_size(_spec_verify_chunk)
 
 
+def test_int8_cache_is_a_program_key_but_compiles_once_per_bucket(params):
+    """Satellite pin (int8 KV-cache PR): the cache dtype IS part of the
+    program key — the int8 pool's avals (s8 pages + f32 scale leaves)
+    lower distinct decode/draft/verify programs from bf16's — but each
+    dtype still compiles exactly one decode program and one draft+verify
+    program per k-bucket, and a second int8 mix with a different request
+    pattern compiles NOTHING. Mix design mirrors the plain pins above
+    (non-evicting 25-page pool, pow2-pinned buckets)."""
+    from midgpt_tpu.sampling.serve import _spec_draft_chunk, _spec_verify_chunk
+    from midgpt_tpu.sampling.spec import self_draft
+
+    def int8_mix(lengths, max_new, seed, spec=False):
+        kw = {}
+        if spec:
+            dcfg, dparams = self_draft(CFG, params, 1)
+            kw = dict(
+                draft_params=dparams, draft_config=dcfg,
+                draft_shares_cache=True, spec_k_max=4, spec_k_min=4,
+                spec_adapt=False,
+            )
+        eng = ServeEngine(
+            CFG, params, max_slots=3, page_size=8, num_pages=25,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype="int8", **kw,
+        )
+        rng = np.random.default_rng(seed)
+        uids = {
+            eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+            for n, m in zip(lengths, max_new)
+        }
+        assert set(eng.run()) == uids
+
+    d0 = jit_cache_size(_serve_decode_chunk)
+    sd0 = jit_cache_size(_spec_draft_chunk)
+    sv0 = jit_cache_size(_spec_verify_chunk)
+    int8_mix((25, 34, 47), (9, 17, 17), seed=0)
+    assert jit_cache_size(_serve_decode_chunk) - d0 == 1, (
+        "int8 decode must be ONE new program"
+    )
+    int8_mix((31, 38, 45), (13, 9, 15), seed=1, spec=True)
+    assert jit_cache_size(_spec_draft_chunk) - sd0 == 1
+    assert jit_cache_size(_spec_verify_chunk) - sv0 == 1
+    with CompileCounter() as cc:
+        int8_mix((26, 33, 40), (9, 17, 9), seed=2)
+        int8_mix((33, 40, 47), (9, 11, 13), seed=3, spec=True)
+    assert cc.count == 0, f"int8 request-mix change recompiled {cc.count}"
+
+
 def test_train_step_compiles_exactly_once():
     cfg = ExperimentConfig(
         rundir="",

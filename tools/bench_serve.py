@@ -86,6 +86,52 @@ def _quick_train(cfg, params, steps: int, seed: int):
     return params, (0.0 if loss is None else float(loss))
 
 
+def _latency_stats(done, t_start):
+    """Per-token latency (chunk-granular), per-request TTFT and per-request
+    tok/s from the finished map. A chunk of n tokens landing gap seconds
+    after the previous event costs gap/n per token; a request's tok/s is
+    its generated tokens over its total residency (queueing included — the
+    user-visible rate)."""
+    import numpy as np
+
+    lat, ttft, req_rate = [], [], []
+    for fr in done.values():
+        ts = np.asarray(fr.token_times)
+        if ts.size == 0:
+            continue
+        ttft.append(ts[0] - t_start)
+        span = max(ts[-1] - t_start, 1e-9)
+        req_rate.append(len(ts) / span)
+        edges = np.flatnonzero(np.diff(ts) > 0) + 1
+        groups = np.split(ts, edges)
+        prev = ts[0]
+        for g in groups[1:]:
+            lat.extend([(g[0] - prev) / len(g)] * len(g))
+            prev = g[0]
+    lat = np.asarray(lat) if lat else np.zeros(1)
+    ttft = np.asarray(ttft) if ttft else np.zeros(1)
+    req_rate = np.asarray(req_rate) if req_rate else np.zeros(1)
+    return lat, ttft, req_rate
+
+
+def _greedy_match_frac(done_a, done_b, trace_uids) -> float:
+    """Fraction of generated-token positions where two greedy runs of the
+    same trace agree — the int8-vs-bf16 accuracy number (docs/SERVING.md
+    'Quantized KV cache': on the quick-fitted bench model expect >= 0.99;
+    on an UNTRAINED model near-uniform logits make argmax fragile under
+    any perturbation, so a raw-init match fraction is meaningless)."""
+    import numpy as np
+
+    match = total = 0
+    for uid, prompt_len in trace_uids:
+        a = np.asarray(done_a[uid].tokens)[prompt_len:]
+        b = np.asarray(done_b[uid].tokens)[prompt_len:]
+        n = min(len(a), len(b))
+        match += int(np.sum(a[:n] == b[:n]))
+        total += max(len(a), len(b))
+    return match / max(total, 1)
+
+
 def _spec_bench(args, cfg, params, cache_dtype, trace, total_new) -> int:
     """--spec mode: speculative vs plain continuous engine, one JSON line
     ('serve_spec' profile, analysis/bench_contract.py)."""
@@ -148,6 +194,8 @@ def _spec_bench(args, cfg, params, cache_dtype, trace, total_new) -> int:
                 "speedup_spec": round(dt_base / dt_spec, 3),
                 "accept_rate": round(stats["accept_rate"], 4),
                 "tokens_per_verify": round(stats["tokens_per_verify"], 3),
+                "kv_dtype": args.kv_dtype,
+                "cache_hbm_bytes": int(eng_spec.cache_hbm_bytes()),
                 "hbm_target_cache_bytes": int(eng_spec.cache_hbm_bytes()),
                 # 0: the prefix self-draft rides the target pool's first
                 # n_draft layers — speculation costs no extra cache HBM
@@ -180,6 +228,20 @@ def main() -> int:
     ap.add_argument("--n-head", type=int, default=None)
     ap.add_argument("--n-embd", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kv_dtype", choices=("bf16", "int8"), default="bf16",
+                    help="paged KV cache storage dtype. int8 stores pages "
+                    "quantized (f32 absmax scales in a side buffer, "
+                    "docs/SERVING.md 'Quantized KV cache'): the model is "
+                    "quick-fitted first (--train-steps) so the reported "
+                    "greedy_match_frac vs a bf16-cache run is meaningful, "
+                    "and a bf16 engine at the SAME pool budget runs for "
+                    "comparison (bf16_* fields)")
+    ap.add_argument("--pool_hbm_bytes", type=int, default=0,
+                    help="byte budget for the paged pool (0 = the default "
+                    "half-of-dedicated sizing): num_pages is derived from "
+                    "the cache dtype, so int8 admits 2x the pages of bf16 "
+                    "at the same spend — THE lever the oversubscription "
+                    "comparison measures")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force CPU with this many virtual devices (0 = native backend)")
     ap.add_argument("--spec", action="store_true",
@@ -229,7 +291,16 @@ def main() -> int:
     params = GPT.init(cfg, jax.random.PRNGKey(args.seed))
     if on_tpu:
         params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
-    cache_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    baseline_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    quantized = args.kv_dtype == "int8"
+    train_loss = None
+    if quantized and not args.spec:
+        # An untrained model's greedy argmax is fragile under ANY cache
+        # perturbation (near-uniform logits), so the int8-vs-bf16 accuracy
+        # number is only meaningful on a model that has learned something
+        # — same reasoning as the --spec bench's quick fit.
+        params, train_loss = _quick_train(cfg, params, args.train_steps, args.seed)
+    cache_dtype = "int8" if quantized else baseline_dtype
 
     # Mixed-length trace: short chat-y prompts to near-context documents.
     rng = np.random.default_rng(args.seed)
@@ -244,7 +315,11 @@ def main() -> int:
     if args.spec:
         return _spec_bench(args, cfg, params, cache_dtype, trace, total_new)
 
-    def run_continuous():
+    pool_kw = (
+        {"pool_hbm_bytes": args.pool_hbm_bytes} if args.pool_hbm_bytes else {}
+    )
+
+    def run_continuous(dtype):
         eng = ServeEngine(
             cfg,
             params,
@@ -253,15 +328,15 @@ def main() -> int:
             prefill_chunk=args.prefill_chunk,
             decode_chunk=args.decode_chunk,
             temperature=0.0,
-            cache_dtype=cache_dtype,
+            cache_dtype=dtype,
+            **pool_kw,
         )
-        for prompt, m in trace:
-            eng.submit(prompt, m)
+        uids = [(eng.submit(p, m), len(p)) for p, m in trace]
         t0 = time.perf_counter()
         done = eng.run()
         # Force everything to host (np conversion happened per chunk already).
         dt = time.perf_counter() - t0
-        return eng, done, dt, t0
+        return eng, done, dt, t0, uids
 
     def run_sequential():
         t0 = time.perf_counter()
@@ -272,29 +347,37 @@ def main() -> int:
         outs = [np.asarray(o) for o in outs]  # force
         return time.perf_counter() - t0
 
-    run_continuous()  # warm every prefill/decode-chunk shape
-    eng, done, dt_cont, t_start = run_continuous()
+    run_continuous(cache_dtype)  # warm every prefill/decode-chunk shape
+    eng, done, dt_cont, t_start, uids = run_continuous(cache_dtype)
     run_sequential()  # warm per-prompt-length prefills + decode chunks
     dt_seq = run_sequential()
 
-    # Per-token latency at chunk granularity: a chunk of n tokens landing
-    # gap seconds after the previous event costs gap/n per token. TTFT is
-    # the first token's time after engine start.
-    lat, ttft = [], []
-    for fr in done.values():
-        ts = np.asarray(fr.token_times)
-        ttft.append(ts[0] - t_start)
-        edges = np.flatnonzero(np.diff(ts) > 0) + 1
-        groups = np.split(ts, edges)
-        prev = ts[0]
-        for g in groups[1:]:
-            lat.extend([(g[0] - prev) / len(g)] * len(g))
-            prev = g[0]
-    lat = np.asarray(lat) if lat else np.zeros(1)
+    # int8 mode: a bf16-cache engine on the SAME trace and pool budget —
+    # the capacity/throughput/accuracy comparison the quantized cache
+    # exists for (at a fixed byte budget it gets HALF the pages, so on an
+    # oversubscribed trace it preempts more and serves slower).
+    bf16_fields = {}
+    if quantized:
+        # genuine bf16 even on the CPU mesh: the capacity claim (2x pages
+        # at the same byte budget) and the accuracy claim (greedy match)
+        # are both vs the bf16 production baseline, not vs the CPU test
+        # mesh's f32 parity dtype
+        run_continuous(jnp.bfloat16)  # warm the bf16-cache shapes
+        eng_bf, done_bf, dt_bf, _, _ = run_continuous(jnp.bfloat16)
+        bf16_fields = {
+            "bf16_continuous_tok_s": round(total_new / dt_bf, 2),
+            "bf16_num_pages": eng_bf.allocator.num_pages,
+            "bf16_preemptions": eng_bf.preemptions,
+            "greedy_match_frac": round(_greedy_match_frac(done, done_bf, uids), 4),
+            "train_steps": args.train_steps,
+            "train_loss": round(train_loss, 3),
+        }
+
+    lat, ttft, req_rate = _latency_stats(done, t_start)
 
     # HBM high-water of the caches (analytic; allocator peak if exposed).
     paged_bytes = eng.cache_hbm_bytes()
-    itemsize = jnp.dtype(cache_dtype).itemsize
+    itemsize = jnp.dtype(baseline_dtype).itemsize
     contiguous_bytes = (
         2 * cfg.n_layer * cfg.n_head * S * cfg.head_dim * itemsize
     )  # per-request KVCache the sequential engine allocates
@@ -312,7 +395,10 @@ def main() -> int:
                 "total_new_tokens": total_new,
                 "max_slots": args.max_slots,
                 "page_size": args.page_size,
+                "kv_dtype": args.kv_dtype,
                 "num_pages": eng.allocator.num_pages,
+                "pool_hbm_bytes": args.pool_hbm_bytes or None,
+                "preemptions": eng.preemptions,
                 "prefill_chunk": args.prefill_chunk,
                 "decode_chunk": args.decode_chunk,
                 "model": {
@@ -327,6 +413,12 @@ def main() -> int:
                 "p50_token_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
                 "p99_token_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
                 "ttft_ms_mean": round(float(np.mean(ttft)) * 1e3, 3),
+                "ttft_ms_p50": round(float(np.percentile(ttft, 50)) * 1e3, 3),
+                "ttft_ms_p95": round(float(np.percentile(ttft, 95)) * 1e3, 3),
+                "req_tok_s_p50": round(float(np.percentile(req_rate, 50)), 2),
+                "req_tok_s_p95": round(float(np.percentile(req_rate, 95)), 2),
+                # pools + (int8) scale side buffers — the true cache spend
+                "cache_hbm_bytes": int(paged_bytes),
                 "hbm_paged_cache_bytes": int(paged_bytes),
                 "hbm_sequential_cache_bytes": int(contiguous_bytes),
                 "device_peak_bytes_in_use": peak,
@@ -334,6 +426,7 @@ def main() -> int:
                 # "request churn never recompiles" claim as a number drivers
                 # can watch for drift (schema: analysis/bench_contract.py).
                 "compile_counts": ServeEngine.compile_stats(),
+                **bf16_fields,
             }
         )
     )
