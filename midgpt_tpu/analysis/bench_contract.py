@@ -171,6 +171,82 @@ def check_serve_spec_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_slo_bench(rec: dict) -> tp.List[str]:
+    """tools/loadgen.py profile: TTFT/TPOT percentiles + shed fraction
+    under a seeded arrival process, at >= 2 offered-load points (one point
+    is a measurement; the contract wants the start of an SLO curve). The
+    headline fields mirror the hottest point so drivers can gate without
+    digging into `points`. NaN rejection rides parse_single_json_line."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "process": (str,),
+            "scheduler": (str,),
+            "seed": (int,),
+            "n_requests": (int,),
+            "error_budget": Number,
+            "model": (dict,),
+            "points": (list,),
+            "ttft_p50_ms": Number,
+            "ttft_p95_ms": Number,
+            "tpot_p50_ms": Number,
+            "tpot_p95_ms": Number,
+            "shed_frac": Number,
+            "timeout_frac": Number,
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_slo":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_slo'"
+        )
+    if rec.get("process") not in (None, "poisson", "bursty"):
+        problems.append(f"field 'process' is {rec.get('process')!r}")
+    if "slo_ok" not in rec or not isinstance(rec["slo_ok"], bool):
+        problems.append("field 'slo_ok' must be a bool")
+    points = rec.get("points")
+    if isinstance(points, list):
+        if len(points) < 2:
+            problems.append(
+                f"{len(points)} load point(s) — the SLO profile requires "
+                ">= 2 offered-load points"
+            )
+        for i, p in enumerate(points):
+            if not isinstance(p, dict):
+                problems.append(f"points[{i}] is not an object")
+                continue
+            pp: tp.List[str] = []
+            _require(
+                p,
+                {
+                    "offered_rps": Number,
+                    "n_offered": (int,),
+                    "completed": (int,),
+                    "shed": (int,),
+                    "timeouts": (int,),
+                    "shed_frac": Number,
+                    "timeout_frac": Number,
+                    "ttft_p50_ms": Number,
+                    "ttft_p95_ms": Number,
+                    "tpot_p50_ms": Number,
+                    "tpot_p95_ms": Number,
+                },
+                pp,
+            )
+            problems.extend(f"points[{i}]: {q}" for q in pp)
+            for frac in ("shed_frac", "timeout_frac"):
+                v = p.get(frac)
+                if isinstance(v, Number) and not 0.0 <= v <= 1.0:
+                    problems.append(f"points[{i}].{frac} {v} outside [0, 1]")
+    sf = rec.get("shed_frac")
+    if isinstance(sf, Number) and not 0.0 <= sf <= 1.0:
+        problems.append(f"shed_frac {sf} outside [0, 1]")
+    return problems
+
+
 def check_graftcheck(rec: dict) -> tp.List[str]:
     """The graftcheck CLI's own --json line."""
     problems: tp.List[str] = []
@@ -201,6 +277,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "train": check_train_bench,
     "serve": check_serve_bench,
     "serve_spec": check_serve_spec_bench,
+    "serve_slo": check_serve_slo_bench,
     "graftcheck": check_graftcheck,
 }
 

@@ -1,0 +1,243 @@
+"""Serving chaos scenarios: inject one of the serving fault kinds into a
+seeded trace and assert the engine DEGRADES instead of breaking.
+
+The training chaos harness (tools/chaos_run.py + robustness/faults.py)
+proves recovery end to end by running the real supervisor against injected
+failures. This module is the serving twin: `run_serving_chaos` runs the
+same seeded request trace twice — once fault-free for reference, once with
+a fault plan armed — and checks the three degradation invariants the chaos
+gate (tests/test_chaos_serve.py, `chaos_run.py --serve`) enforces:
+
+  1. **Alive** — the engine (and, for client faults, the async front door)
+     finishes the trace; no fault kind may crash the process.
+  2. **Conserved** — every pool page is back on the free list afterwards
+     (`free_count == num_pages - 1`), whatever was shed/killed/poisoned.
+  3. **Isolated** — greedy token streams of UNAFFECTED requests are
+     bit-identical to the fault-free run (greedy determinism pin,
+     tests/test_chaos_serve.py). "Affected" is fault-specific and
+     engine-reported: `poisoned_uids` for poisoned_page, non-"ok" statuses
+     for sheds/timeouts/cancels. kill_mid_decode affects NOBODY — its
+     recovery is recompute preemption, which is parity-preserving — so
+     there every request must match.
+
+Faults are deterministic for a seeded trace: kill_mid_decode/poisoned_page
+key on the engine's round counter (`kill_mid_decode@7` = round 7),
+slow_client keys on the victim uid, submit_storm keys on the arrival index
+at which the burst lands. This module is import-light glue; the faults it
+arms live in the one registry every chaos path shares.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import typing as tp
+
+import numpy as np
+
+from midgpt_tpu.robustness import faults
+
+# Storm burst: how many clone requests the submit_storm fault slams into
+# the engine at its arrival index (sized to overrun the default backlog
+# budget below several times over).
+STORM_SIZE = 8
+# Backlog budget armed for storm scenarios — small enough that the burst
+# MUST shed, big enough that the base trace admits.
+STORM_BACKLOG_PAGES = 24
+
+
+def _tiny_model(seed: int):
+    import jax
+
+    from midgpt_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(
+        block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32
+    )
+    return cfg, GPT.init(cfg, jax.random.PRNGKey(seed))
+
+
+def _trace(cfg, seed: int, n_requests: int):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_requests):
+        t0 = int(rng.integers(4, 24))
+        m = int(rng.integers(6, 16))
+        out.append((rng.integers(0, cfg.vocab_size, t0).astype(np.int32), m))
+    return out
+
+
+def _engine(cfg, params, *, max_backlog_pages=None, clock=None):
+    import jax.numpy as jnp
+
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    kw: tp.Dict[str, tp.Any] = {}
+    if clock is not None:
+        kw["clock"] = clock
+    return ServeEngine(
+        cfg,
+        params,
+        max_slots=3,
+        page_size=8,
+        # NOT 25: the pool size is a program-key dim, and the recompile pin
+        # (tests/test_recompile_pins.py) counts compiles of the 25-page f32
+        # program set from a pristine baseline — chaos runs in the same
+        # pytest process must not pre-warm that exact geometry.
+        num_pages=29,
+        prefill_chunk=16,
+        decode_chunk=4,
+        temperature=0.0,
+        cache_dtype=jnp.float32,
+        max_backlog_pages=max_backlog_pages,
+        **kw,
+    )
+
+
+def _run_plain(eng, trace, storm: bool):
+    """Drive the engine synchronously. Returns (uid -> trace index,
+    n_storm_shed). With `storm`, each arrival consults the submit_storm
+    fault (step = arrival index) and, when it fires, slams STORM_SIZE
+    clones of that request in at once — the admitted ones compete for the
+    pool like real duplicate traffic, the rest must shed."""
+    from midgpt_tpu.sampling.serve import BackpressureError
+
+    uid_to_idx: tp.Dict[int, int] = {}
+    storm_shed = 0
+    for idx, (prompt, m) in enumerate(trace):
+        if storm and faults.should_fire("submit_storm", step=idx):
+            for _ in range(STORM_SIZE):
+                try:
+                    eng.submit(prompt, m)  # clones: excluded from parity
+                except BackpressureError:
+                    storm_shed += 1
+        try:
+            uid_to_idx[eng.submit(prompt, m)] = idx
+        except BackpressureError:
+            storm_shed += 1
+    eng.run()
+    return uid_to_idx, storm_shed
+
+
+def _run_server(eng, trace):
+    """Drive the engine through the async front door, one consumer task
+    per request, collecting delivered tokens (what a client actually saw —
+    the thing slow-client sheds must not corrupt for anyone else)."""
+    from midgpt_tpu.sampling.server import AsyncServeServer
+
+    delivered: tp.Dict[int, tp.List[int]] = {}
+    uid_to_idx: tp.Dict[int, int] = {}
+
+    async def main():
+        server = AsyncServeServer(
+            eng, max_buffered_tokens=4, submit_retries=1, idle_poll_s=0.001
+        )
+        driver = asyncio.create_task(server.run())
+
+        async def consume(uid):
+            delivered[uid] = []
+            async for tok in server.stream(uid):
+                delivered[uid].append(tok)
+
+        consumers = []
+        for idx, (prompt, m) in enumerate(trace):
+            uid = await server.submit(prompt, m)
+            uid_to_idx[uid] = idx
+            consumers.append(asyncio.create_task(consume(uid)))
+        await asyncio.gather(*consumers)
+        await server.drain()
+        await driver
+
+    asyncio.run(main())
+    return uid_to_idx, delivered
+
+
+def run_serving_chaos(
+    fault_plan: str, *, seed: int = 0, n_requests: int = 5
+) -> tp.Dict[str, tp.Any]:
+    """Run the scenario (module docstring); returns the summary dict that
+    `chaos_run.py --serve` emits as its JSON line. Raises AssertionError
+    when a degradation invariant breaks — that IS the chaos verdict."""
+    cfg, params = _tiny_model(seed)
+    trace = _trace(cfg, seed + 1, n_requests)
+    uses_server = "slow_client" in fault_plan
+    uses_storm = "submit_storm" in fault_plan
+
+    # Fault-free reference pass (also warms every jit shape, so the fault
+    # pass's timings/timeouts cannot hinge on compile stalls).
+    faults.clear()
+    ref = _engine(cfg, params)
+    ref_uids, _ = _run_plain(ref, trace, storm=False)
+    ref_tokens = {
+        idx: np.asarray(ref.finished[uid].tokens)
+        for uid, idx in ref_uids.items()
+    }
+
+    faults.clear()
+    armed = faults.activate_plan(fault_plan)
+    eng = _engine(
+        cfg, params,
+        max_backlog_pages=STORM_BACKLOG_PAGES if uses_storm else None,
+    )
+    delivered: tp.Optional[tp.Dict[int, tp.List[int]]] = None
+    storm_shed = 0
+    if uses_server:
+        uid_to_idx, delivered = _run_server(eng, trace)
+    else:
+        uid_to_idx, storm_shed = _run_plain(eng, trace, storm=uses_storm)
+    fired = faults.fired_counts()
+    faults.clear()
+
+    # -- invariant 2: page conservation + engine still serviceable -------
+    assert eng.idle, "engine left work behind"
+    conserved = eng.allocator.free_count == eng.allocator.num_pages - 1
+    assert conserved, (
+        f"page leak: {eng.allocator.free_count} free of "
+        f"{eng.allocator.num_pages - 1} allocatable"
+    )
+
+    # -- invariant 3: unaffected greedy streams are bit-identical --------
+    affected = set(eng.poisoned_uids)
+    statuses: tp.Dict[str, int] = {}
+    parity_checked = parity_ok = 0
+    for uid, idx in uid_to_idx.items():
+        fr = eng.finished.get(uid)
+        assert fr is not None, f"request {uid} vanished"
+        statuses[fr.status] = statuses.get(fr.status, 0) + 1
+        if fr.status != "ok":
+            affected.add(uid)  # shed/timeout/slow_client: partial by design
+        if uid in affected:
+            continue
+        parity_checked += 1
+        if np.array_equal(np.asarray(fr.tokens), ref_tokens[idx]):
+            parity_ok += 1
+        if delivered is not None:
+            # What the client consumed must be a prefix of the reference
+            # generation — streaming may trail the engine, never diverge.
+            prompt_len = len(trace[idx][0])
+            got = np.asarray(delivered[uid], np.int32)
+            want = ref_tokens[idx][prompt_len:prompt_len + len(got)]
+            assert np.array_equal(got, want), (
+                f"delivered stream diverged for request {uid}"
+            )
+    assert parity_ok == parity_checked, (
+        f"greedy parity broke on {parity_checked - parity_ok} unaffected "
+        f"request(s)"
+    )
+    assert sum(fired.values()) >= min(1, len(armed)), "no armed fault fired"
+
+    return {
+        "mode": "serve",
+        "fault_plan": fault_plan,
+        "faults_fired": fired,
+        "n_requests": n_requests,
+        "statuses": statuses,
+        "shed": eng.shed + storm_shed,
+        "timeouts": eng.timeouts,
+        "cancelled": eng.cancelled,
+        "decode_kills": eng.decode_kills,
+        "preemptions": eng.preemptions,
+        "poisoned": len(eng.poisoned_uids),
+        "parity_checked": parity_checked,
+        "parity_ok": parity_ok,
+        "pages_conserved": conserved,
+    }

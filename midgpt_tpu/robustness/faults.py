@@ -26,6 +26,30 @@ via tools/chaos_run.py):
                      arrived mid-step — drives the emergency-save path
                      without depending on signal-delivery timing.
 
+Serving kinds (hooked in sampling/serve.py `ServeEngine.step`, the async
+front door sampling/server.py, and the chaos scenario driver
+robustness/chaos_serve.py; the step key is the engine's ROUND counter or —
+submit_storm — the workload's arrival index, so a seeded trace makes every
+firing deterministic):
+
+  kill_mid_decode    the round's decode/spec dispatch dies before its
+                     tokens land (device restart, tunnel drop); every
+                     decode-ready slot is recompute-preempted and the
+                     token streams must come out identical to an
+                     unfaulted run.
+  poisoned_page      corrupt one live slot's first pool page in place
+                     (HBM damage); page isolation must keep every OTHER
+                     slot's stream bit-identical while the engine keeps
+                     serving.
+  slow_client        a streaming client stops draining its token queue;
+                     the server's bounded per-client buffer must shed
+                     exactly that client (status "slow_client") without
+                     stalling the engine or its neighbors.
+  submit_storm       a burst of simultaneous submissions beyond the
+                     backpressure budget; admission must shed the excess
+                     (BackpressureError) and serve the admitted rest to
+                     completion.
+
 Activation: programmatic (`activate(...)`), or a plan string from config
 (`ExperimentConfig.fault_plan`) / the MIDGPT_FAULTS env var, parsed by
 `activate_plan`: comma-separated `kind[@step][*times]`, e.g.
@@ -46,6 +70,11 @@ KINDS = (
     "kill_mid_save",
     "truncate_ckpt_item",
     "preempt",
+    # serving (sampling/serve.py, sampling/server.py, chaos_serve.py)
+    "kill_mid_decode",
+    "poisoned_page",
+    "slow_client",
+    "submit_storm",
 )
 
 _PLAN_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$")

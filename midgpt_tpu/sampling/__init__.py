@@ -1,4 +1,15 @@
 from midgpt_tpu.sampling.engine import generate
-from midgpt_tpu.sampling.serve import ServeEngine
+from midgpt_tpu.sampling.scheduler import FCFSScheduler, Scheduler, SLOScheduler
+from midgpt_tpu.sampling.serve import BackpressureError, ServeEngine
+from midgpt_tpu.sampling.server import AsyncServeServer, ServerDraining
 
-__all__ = ["generate", "ServeEngine"]
+__all__ = [
+    "generate",
+    "ServeEngine",
+    "BackpressureError",
+    "AsyncServeServer",
+    "ServerDraining",
+    "Scheduler",
+    "FCFSScheduler",
+    "SLOScheduler",
+]

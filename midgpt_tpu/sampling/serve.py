@@ -39,20 +39,47 @@ buckets [spec_k_min, spec_k_max]; rejected tail positions roll back
 page-aligned (length counters reset, tail pages freed, device pool never
 rewritten).
 
-When the pool runs dry, the scheduler EVICTS the youngest running slot
+When the pool runs dry, the scheduler EVICTS a younger running slot
 (frees its pages, pushes the request back to the queue front with its
 generated tokens folded into the prompt — recompute-style preemption), so
 the oldest requests always make progress and the engine never deadlocks.
+WHICH younger slot — like the admission order and the shed decision — is a
+pluggable policy (`sampling/scheduler.py`): `FCFSScheduler` (the default:
+queue-head admission, youngest-first eviction, budget-only shedding) or
+`SLOScheduler` (earliest-deadline-first admission, most-slack-first
+eviction, infeasible-deadline shedding). Policies are pure host code; the
+compiled program set is policy-independent (tests/test_scheduler.py).
 
 Robustness levers (each round starts with an expiry pass):
 
   * **Per-request deadline/TTL** — `submit(..., ttl_s=...)`: a request that
     is still queued or generating past its deadline is finished with
     `status="timeout"` (partial tokens returned) and its pages freed, so a
-    stalled client cannot occupy pool pages forever.
+    stalled client cannot occupy pool pages forever. All deadline math runs
+    on the injectable `clock=` callable (default `time.perf_counter`), so
+    TTL behavior is testable with a fake clock instead of sleeps.
   * **Backpressure** — `max_backlog_pages` bounds the worst-case page
     demand of all live requests; `submit` raises BackpressureError beyond
     it instead of growing the queue (and the eviction churn) without bound.
+    The exception carries `retry_after_pages` / `backlog_pages` /
+    `retryable` so callers back off programmatically (sampling/server.py)
+    instead of string-parsing the message.
+  * **Cancellation** — `cancel(uid)` finishes a queued or running request
+    immediately (status "cancelled", pages freed) without perturbing
+    co-resident slots; the async front door maps client disconnects onto
+    it (tests/test_serving.py pins page conservation and neighbor-token
+    stability).
+  * **Fault hooks** — `step()` consults the robustness/faults.py registry
+    for the serving fault kinds (`kill_mid_decode`: the round's decode
+    dispatch dies and every decode-ready slot is recompute-preempted;
+    `poisoned_page`: one live page is corrupted in place, modeling HBM
+    damage — page isolation keeps every other slot's stream intact).
+    With an empty registry (always, in production) each hook is a scan
+    over an empty list. Chaos scenarios: robustness/chaos_serve.py.
+
+Streaming hooks: `on_token(uid, token, t)` fires per generated token and
+`on_finish(FinishedRequest)` on every terminal transition (finish, EOS,
+timeout, cancel) — the async server's per-token streaming rides these.
 
 Greedy (temperature=0) serving is token-for-token identical to
 `engine.generate` on the same prompt (parity pin in tests/test_sampling.py);
@@ -72,7 +99,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, PagedKVCache
+from midgpt_tpu.robustness import faults
 from midgpt_tpu.sampling.engine import sample_logits, warp_logits
+from midgpt_tpu.sampling.scheduler import FCFSScheduler, Scheduler
 from midgpt_tpu.sampling.spec import speculative_accept
 
 Array = jax.Array
@@ -278,10 +307,44 @@ class PageAllocator:
 
 
 class BackpressureError(RuntimeError):
-    """Admission would oversubscribe the page pool beyond the configured
-    backlog budget — the caller should shed load or retry later, instead of
-    the request sitting in an unbounded queue (or thrashing the pool with
-    evictions) indefinitely."""
+    """Admission was refused — the caller should shed load or (when
+    `retryable`) retry later, instead of the request sitting in an
+    unbounded queue (or thrashing the pool with evictions) indefinitely.
+
+    Structured fields (so callers never string-parse the message):
+
+      needed_pages     worst-case pages the refused request would commit
+      backlog_pages    worst-case pages already committed to live requests
+      budget_pages     the engine's `max_backlog_pages` (None = unbounded)
+      retryable        False when waiting cannot help (e.g. the
+                       SLOScheduler shed an already-infeasible deadline);
+                       True for capacity sheds — pages free as requests
+                       finish, so a bounded retry-with-backoff is sane
+                       (sampling/server.py does exactly that)
+      retry_after_pages  pages that must free before a retry can admit
+                       (None when any ingredient is unknown)
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        needed_pages: tp.Optional[int] = None,
+        backlog_pages: tp.Optional[int] = None,
+        budget_pages: tp.Optional[int] = None,
+        retryable: bool = True,
+    ):
+        super().__init__(message)
+        self.needed_pages = needed_pages
+        self.backlog_pages = backlog_pages
+        self.budget_pages = budget_pages
+        self.retryable = retryable
+
+    @property
+    def retry_after_pages(self) -> tp.Optional[int]:
+        if None in (self.needed_pages, self.backlog_pages, self.budget_pages):
+            return None
+        return max(0, self.backlog_pages + self.needed_pages - self.budget_pages)
 
 
 @dataclasses.dataclass
@@ -353,10 +416,18 @@ class ServeEngine:
         spec_k_max: int = 4,
         spec_k_min: int = 1,
         spec_adapt: bool = True,
+        scheduler: tp.Optional[Scheduler] = None,
+        clock: tp.Callable[[], float] = time.perf_counter,
+        on_token: tp.Optional[tp.Callable[[int, int, float], None]] = None,
+        on_finish: tp.Optional[tp.Callable[["FinishedRequest"], None]] = None,
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
         self.config = config
         self.params = params
+        self.scheduler = scheduler if scheduler is not None else FCFSScheduler()
+        self._clock = clock
+        self.on_token = on_token
+        self.on_finish = on_finish
         self.page_size = page_size
         self.max_slots = max_slots
         self.prefill_chunk = prefill_chunk
@@ -463,6 +534,18 @@ class ServeEngine:
         # mode's 2x pages shows up here as strictly fewer evictions on the
         # same trace (tests/test_quant_cache.py; reported by bench_serve).
         self.preemptions = 0
+        # Robustness/SLO counters (reported by tools/loadgen.py and the
+        # chaos serve scenarios): scheduling rounds, deadline timeouts,
+        # admission sheds, client cancellations, and killed decode rounds.
+        self.rounds = 0
+        self.timeouts = 0
+        self.shed = 0
+        self.cancelled = 0
+        self.decode_kills = 0
+        # uids whose pool pages were corrupted by the poisoned_page fault —
+        # the slots a chaos parity check must exclude (everyone else's
+        # stream never reads the poisoned physical page).
+        self.poisoned_uids: tp.List[int] = []
 
     # -- public surface ------------------------------------------------
 
@@ -476,8 +559,9 @@ class ServeEngine:
         """Queue a request. `ttl_s` bounds its total residence time: a
         request still unfinished `ttl_s` seconds from now is evicted with a
         `timeout` status instead of occupying queue slots / pool pages
-        forever. Raises BackpressureError when the engine's worst-case page
-        backlog (`max_backlog_pages`) is already committed."""
+        forever. Raises BackpressureError when the scheduler policy sheds
+        the request (over the `max_backlog_pages` budget, or — SLOScheduler
+        — an already-infeasible deadline)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         S = self.config.block_size
         if len(prompt) + max_new_tokens > S:
@@ -494,19 +578,21 @@ class ServeEngine:
                 f"request needs {need} pages but the pool only has "
                 f"{self.allocator.num_pages - 1} allocatable"
             )
-        if self.max_backlog_pages is not None:
-            backlog = self._backlog_pages()
-            if backlog + need > self.max_backlog_pages:
-                raise BackpressureError(
-                    f"admission refused: request needs {need} worst-case "
-                    f"pages on top of a committed backlog of {backlog} "
-                    f"(budget {self.max_backlog_pages}) — the pool is "
-                    "oversubscribed; shed load or retry after requests "
-                    "finish"
-                )
+        now = self._clock()
+        deadline = None if ttl_s is None else now + ttl_s
+        shed = self.scheduler.shed_reason(need, deadline, self, now)
+        if shed is not None:
+            message, retryable = shed
+            self.shed += 1
+            raise BackpressureError(
+                message,
+                needed_pages=need,
+                backlog_pages=self._backlog_pages(),
+                budget_pages=self.max_backlog_pages,
+                retryable=retryable,
+            )
         uid = self._uid
         self._uid += 1
-        deadline = None if ttl_s is None else time.perf_counter() + ttl_s
         self.queue.append(Request(uid, prompt, max_new_tokens, eos_id, deadline))
         return uid
 
@@ -532,6 +618,46 @@ class ServeEngine:
         while not self.idle:
             self.step()
         return self.finished
+
+    def cancel(self, uid: int, status: str = "cancelled") -> bool:
+        """Finish a queued or running request NOW: its pages return to the
+        pool, its partial tokens are recorded under `status`, and no other
+        slot is touched — cancellation must never perturb a co-resident
+        request's stream (pinned with the page-conservation invariant in
+        tests/test_serving.py). A request preempted earlier returns its
+        re-queued prompt (generated tokens folded in). False if `uid` is
+        unknown or already finished. Call between rounds only (the engine
+        is single-threaded host code; the async server serializes its
+        cancellations onto the driver loop)."""
+        for qi, req in enumerate(self.queue):
+            if req.uid == uid:
+                self.queue.pop(qi)
+                self.cancelled += 1
+                self._finish(
+                    FinishedRequest(
+                        uid=uid, tokens=req.prompt, token_times=[],
+                        status=status,
+                    )
+                )
+                return True
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.request.uid == uid:
+                req = slot.request
+                self.cancelled += 1
+                self._finish(
+                    FinishedRequest(
+                        uid=uid,
+                        tokens=np.concatenate(
+                            [req.prompt, np.asarray(slot.generated, np.int32)]
+                        ),
+                        token_times=slot.token_times,
+                        status=status,
+                    )
+                )
+                self.allocator.free(slot.pages)
+                self.slots[i] = None
+                return True
+        return False
 
     def cache_hbm_bytes(self) -> int:
         """Total device bytes of the target pool — K/V pages plus, in int8
@@ -563,14 +689,78 @@ class ServeEngine:
 
     def step(self) -> None:
         """One round: expire -> admit -> one prefill chunk -> one decode
-        chunk (or one draft-then-verify speculative round)."""
+        chunk (or one draft-then-verify speculative round).
+
+        The two serving fault hooks fire here (robustness/faults.py; an
+        empty registry — the default, always — costs a scan over nothing).
+        Both are keyed on the ROUND counter so chaos scenarios are
+        deterministic for a seeded trace (`kill_mid_decode@7` always
+        strikes round 7)."""
+        self.rounds += 1
+        if faults.should_fire("poisoned_page", step=self.rounds):
+            self._poison_page()
         self._expire_round()
         self._admit()
         self._prefill_round()
-        if self.draft_params is not None:
+        if faults.should_fire("kill_mid_decode", step=self.rounds):
+            self._kill_decode_round()
+        elif self.draft_params is not None:
             self._spec_round()
         else:
             self._decode_round()
+
+    def _kill_decode_round(self) -> None:
+        """The `kill_mid_decode` fault: this round's decode dispatch died
+        (device restart, tunnel drop) and its tokens never landed. Recovery
+        is the eviction machinery the engine already trusts: every
+        decode-ready slot is recompute-preempted — pages freed, generated
+        tokens folded into the prompt, re-queued oldest-first — so the
+        requests re-prefill and continue with token streams identical to an
+        unfaulted run (greedy recompute parity is pinned by
+        tests/test_serving.py::test_serve_parity_under_eviction and
+        asserted end to end by the chaos gate, tests/test_chaos_serve.py).
+        Mid-prefill slots are untouched: the fault models the DECODE
+        program dying, and prefill chunks already landed."""
+        victims = [
+            s
+            for s in self.slots
+            if s is not None and not s.prefilling and s.remaining > 0
+        ]
+        # Youngest evicts first: each _evict inserts at the queue FRONT, so
+        # reverse admit order leaves the queue oldest-first for re-admission.
+        for s in sorted(victims, key=lambda s: s.admit_order, reverse=True):
+            self._evict(s)
+        self.decode_kills += 1
+
+    def _poison_page(self) -> None:
+        """The `poisoned_page` fault: corrupt the first page of the
+        youngest running slot in place (NaN for float pools, saturated 127
+        for int8), modeling HBM damage to committed K/V. No recovery is
+        attempted — the point the chaos gate asserts is ISOLATION: page
+        tables never alias live pages, so every other slot's tokens are
+        bit-identical to an unfaulted run, the engine keeps serving, and
+        the allocator stays conserved. The victim uid lands in
+        `poisoned_uids` so chaos parity checks exclude exactly it
+        (tests/test_chaos_serve.py pins the isolation claim)."""
+        victim = max(
+            (s for s in self.slots if s is not None and s.pages),
+            key=lambda s: s.admit_order,
+            default=None,
+        )
+        if victim is None:
+            return
+        page = victim.pages[0]
+        bad = (
+            float("nan")
+            if jnp.issubdtype(self.cache.k.dtype, jnp.floating)
+            else 127
+        )
+        self.cache = dataclasses.replace(
+            self.cache,
+            k=self.cache.k.at[:, :, page].set(bad),
+            v=self.cache.v.at[:, :, page].set(bad),
+        )
+        self.poisoned_uids.append(victim.request.uid)
 
     def _expire_round(self) -> None:
         """Finish every deadline-expired request with a `timeout` status.
@@ -580,7 +770,7 @@ class ServeEngine:
         deadline must not hold pool pages hostage while younger requests
         get evicted around it. Whatever tokens were generated before the
         deadline are returned (partial result)."""
-        now = time.perf_counter()
+        now = self._clock()
 
         def expired(req: Request) -> bool:
             return req.deadline is not None and now > req.deadline
@@ -588,9 +778,12 @@ class ServeEngine:
         still_queued = []
         for req in self.queue:
             if expired(req):
-                self.finished[req.uid] = FinishedRequest(
-                    uid=req.uid, tokens=req.prompt, token_times=[],
-                    status="timeout",
+                self.timeouts += 1
+                self._finish(
+                    FinishedRequest(
+                        uid=req.uid, tokens=req.prompt, token_times=[],
+                        status="timeout",
+                    )
                 )
             else:
                 still_queued.append(req)
@@ -598,21 +791,30 @@ class ServeEngine:
         for i, slot in enumerate(self.slots):
             if slot is not None and expired(slot.request):
                 req = slot.request
-                self.finished[req.uid] = FinishedRequest(
-                    uid=req.uid,
-                    tokens=np.concatenate(
-                        [req.prompt, np.asarray(slot.generated, np.int32)]
-                    ),
-                    token_times=slot.token_times,
-                    status="timeout",
+                self.timeouts += 1
+                self._finish(
+                    FinishedRequest(
+                        uid=req.uid,
+                        tokens=np.concatenate(
+                            [req.prompt, np.asarray(slot.generated, np.int32)]
+                        ),
+                        token_times=slot.token_times,
+                        status="timeout",
+                    )
                 )
                 self.allocator.free(slot.pages)
                 self.slots[i] = None
 
     def _admit(self) -> None:
+        now = self._clock()
         for i, s in enumerate(self.slots):
             if s is None and self.queue:
-                req = self.queue.pop(0)
+                # Admission ORDER is the scheduler's call (FCFS: the queue
+                # head; SLO: earliest deadline first).
+                qi = self.scheduler.select_admit(self.queue, now)
+                if qi is None:
+                    break
+                req = self.queue.pop(qi)
                 # A preempted request restarts its k adaptation from
                 # spec_k_max like a fresh one — the draft pool it re-prefills
                 # is fresh too, so old acceptance evidence is stale anyway.
@@ -621,25 +823,33 @@ class ServeEngine:
 
     def _ensure_pages(self, slot: _Slot, upto_tokens: int) -> bool:
         """Grow slot's page list to cover positions [0, upto_tokens);
-        True on success. On pool exhaustion, evicts younger slots (youngest
-        first) and retries; False only if slot itself is the youngest left."""
+        True on success. On pool exhaustion, asks the scheduler to pick a
+        preemption victim among the STRICTLY YOUNGER running slots (the
+        engine-enforced deadlock-freedom invariant: the oldest request
+        always makes progress regardless of policy) and retries; False
+        only when no younger victim exists or the policy defers."""
         need = -(-upto_tokens // self.page_size) - len(slot.pages)
         while need > 0:
             got = self.allocator.alloc(need)
             if got is not None:
                 slot.pages.extend(got)
                 return True
-            victim = max(
-                (
-                    s
-                    for s in self.slots
-                    if s is not None and s.admit_order > slot.admit_order
-                ),
-                key=lambda s: s.admit_order,
-                default=None,
-            )
+            candidates = [
+                s
+                for s in self.slots
+                if s is not None and s.admit_order > slot.admit_order
+            ]
+            if not candidates:
+                return False
+            victim = self.scheduler.select_victim(slot, candidates, self._clock())
             if victim is None:
                 return False
+            if not any(victim is c for c in candidates):
+                raise RuntimeError(
+                    f"scheduler {self.scheduler.name!r} returned a "
+                    "non-candidate victim — preemption must pick from the "
+                    "strictly-younger running slots it was offered"
+                )
             self._evict(victim)
         return True
 
@@ -758,7 +968,7 @@ class ServeEngine:
                         self.top_p,
                     )[0]
                 )
-            self._append_token(slot_i, slot, tok, time.perf_counter())
+            self._append_token(slot_i, slot, tok, self._clock())
 
     def _decode_round(self) -> None:
         active_idx = [
@@ -826,7 +1036,7 @@ class ServeEngine:
             key,
         )
         toks = np.asarray(toks)  # (n, B) — forces the dispatch
-        t_done = time.perf_counter()
+        t_done = self._clock()
         for i in active_idx:
             slot = self.slots[i]
             if slot is None:
@@ -944,7 +1154,7 @@ class ServeEngine:
         )
         n_accept = np.asarray(n_accept)
         out = np.asarray(out)  # forces both dispatches
-        t_done = time.perf_counter()
+        t_done = self._clock()
         self._spec_rounds += 1
         for i in active_idx:
             slot = self.slots[i]
@@ -995,20 +1205,32 @@ class ServeEngine:
             / verifies,
         }
 
+    def _finish(self, fr: FinishedRequest) -> None:
+        """Record a terminal transition (ok/EOS/timeout/cancelled) and fire
+        the streaming hook — the ONE funnel every path to `finished` goes
+        through, so the async server never misses an ending."""
+        self.finished[fr.uid] = fr
+        if self.on_finish is not None:
+            self.on_finish(fr)
+
     def _append_token(self, slot_i: int, slot: _Slot, tok: int, t: float) -> bool:
         """Record one generated token; returns True if the request finished
         (and the slot was freed)."""
         slot.generated.append(tok)
         slot.token_times.append(t)
         req = slot.request
+        if self.on_token is not None:
+            self.on_token(req.uid, tok, t)
         hit_eos = req.eos_id is not None and tok == req.eos_id
         if hit_eos or len(slot.generated) >= req.max_new_tokens:
-            self.finished[req.uid] = FinishedRequest(
-                uid=req.uid,
-                tokens=np.concatenate(
-                    [req.prompt, np.asarray(slot.generated, np.int32)]
-                ),
-                token_times=slot.token_times,
+            self._finish(
+                FinishedRequest(
+                    uid=req.uid,
+                    tokens=np.concatenate(
+                        [req.prompt, np.asarray(slot.generated, np.int32)]
+                    ),
+                    token_times=slot.token_times,
+                )
             )
             self.allocator.free(slot.pages)
             self.slots[slot_i] = None

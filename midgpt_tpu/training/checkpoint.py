@@ -43,13 +43,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import time
 import typing as tp
 
 import jax
 import orbax.checkpoint as ocp
 
 from midgpt_tpu.robustness import faults
+from midgpt_tpu.robustness.backoff import retry_with_backoff
 from midgpt_tpu.robustness.errors import (
     CheckpointCorruptError,
     CheckpointWriteError,
@@ -259,27 +259,28 @@ class CheckpointManager:
             format=ocp.args.JsonSave(FORMAT),
             **{name: ocp.args.StandardSave(item) for name, item in state.items()},
         )
-        last_err: tp.Optional[BaseException] = None
-        queued = False
-        for attempt in range(self.write_retries):
-            try:
-                if faults.should_fire("ckpt_io_error"):
-                    raise IOError(
-                        "injected transient checkpoint-write failure "
-                        "(faults: ckpt_io_error)"
-                    )
-                queued = self._mngr.save(step, args=args, force=True)
-                last_err = None
-                break
-            except OSError as e:  # includes IOError; TensorStore fs failures
-                last_err = e
-                if attempt + 1 < self.write_retries:
-                    time.sleep(self.retry_backoff_sec * (2**attempt))
-        if last_err is not None:
+        def _queue_write() -> bool:
+            if faults.should_fire("ckpt_io_error"):
+                raise IOError(
+                    "injected transient checkpoint-write failure "
+                    "(faults: ckpt_io_error)"
+                )
+            return self._mngr.save(step, args=args, force=True)
+
+        try:
+            # Shared retry discipline (robustness/backoff.py) — the same
+            # schedule the serving front door applies to BackpressureError.
+            queued = retry_with_backoff(
+                _queue_write,
+                retries=self.write_retries,
+                base_s=self.retry_backoff_sec,
+                retry_on=(OSError,),  # includes IOError; TensorStore failures
+            )
+        except OSError as e:
             raise CheckpointWriteError(
                 f"checkpoint save at step {step} under {self._dir} failed "
-                f"{self.write_retries} attempt(s); last error: {last_err}"
-            ) from last_err
+                f"{self.write_retries} attempt(s); last error: {e}"
+            ) from e
         if faults.should_fire("kill_mid_save", step=step):
             # Model SIGKILL between the TensorStore write and the manifest
             # commit: bytes on disk, one item truncated, no manifest —

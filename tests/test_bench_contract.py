@@ -13,6 +13,7 @@ import sys
 from midgpt_tpu.analysis.bench_contract import (
     check_bench_stdout,
     check_serve_bench,
+    check_serve_slo_bench,
     check_train_bench,
     parse_single_json_line,
 )
@@ -94,6 +95,34 @@ def test_bench_serve_spec_emits_conformant_json_line(capsys):
     assert rec["hbm_draft_cache_bytes"] == 0
 
 
+def test_loadgen_emits_conformant_serve_slo_line(capsys):
+    """tools/loadgen.py (SLO load harness) holds the one-JSON-line
+    contract: a short seeded-arrival Poisson run against the CPU-mesh
+    engine at TWO offered-load points, validated by the serve_slo profile.
+    Structure check, not a latency claim — arrivals are deterministic
+    (seeded), wall-clock percentiles are whatever the host gives."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "loadgen.py"),
+        [
+            "loadgen.py",
+            "--rates", "30,90",
+            "--n-requests", "4",
+            "--seed", "0",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_slo")
+    assert not problems, problems
+    assert rec["process"] == "poisson" and rec["scheduler"] == "fcfs"
+    assert len(rec["points"]) == 2
+    assert [p["offered_rps"] for p in rec["points"]] == [30.0, 90.0]
+    for p in rec["points"]:
+        assert p["n_offered"] == 4
+        assert p["completed"] + p["shed"] + p["timeouts"] <= p["n_offered"]
+        assert 0.0 <= p["shed_frac"] <= 1.0
+    assert isinstance(rec["slo_ok"], bool)
+
+
 def test_bench_train_emits_conformant_json_line(capsys):
     out = _run_entry_point(
         os.path.join(REPO, "bench.py"),
@@ -152,3 +181,33 @@ def test_checker_catches_field_drift():
     assert any(
         "bench" in p for p in check_serve_bench({"bench": "other"})
     )
+
+
+def test_serve_slo_checker_catches_drift():
+    point = {
+        "offered_rps": 30.0, "n_offered": 4, "completed": 4, "shed": 0,
+        "timeouts": 0, "shed_frac": 0.0, "timeout_frac": 0.0,
+        "ttft_p50_ms": 5.0, "ttft_p95_ms": 9.0, "tpot_p50_ms": 1.0,
+        "tpot_p95_ms": 2.0,
+    }
+    good = {
+        "bench": "serve_slo", "backend": "cpu", "process": "poisson",
+        "scheduler": "fcfs", "seed": 0, "n_requests": 4,
+        "error_budget": 0.2, "model": {}, "slo_ok": True,
+        "points": [point, dict(point, offered_rps=90.0)],
+        "ttft_p50_ms": 5.0, "ttft_p95_ms": 9.0, "tpot_p50_ms": 1.0,
+        "tpot_p95_ms": 2.0, "shed_frac": 0.0, "timeout_frac": 0.0,
+    }
+    assert check_serve_slo_bench(good) == []
+    # one load point is a measurement, not the SLO curve the profile wants
+    one_point = dict(good, points=[point])
+    assert any(">= 2" in p for p in check_serve_slo_bench(one_point))
+    # a renamed per-point percentile field fails with the point index
+    bad_point = dict(point)
+    bad_point["ttft95_ms"] = bad_point.pop("ttft_p95_ms")
+    drifted = dict(good, points=[point, bad_point])
+    assert any("points[1]" in p and "ttft_p95_ms" in p
+               for p in check_serve_slo_bench(drifted))
+    # shed_frac outside [0, 1] is a contract violation, not a number
+    assert any("outside" in p
+               for p in check_serve_slo_bench(dict(good, shed_frac=1.5)))

@@ -1,0 +1,100 @@
+"""The serving chaos gate: every serving fault kind, end to end through
+robustness/chaos_serve.py (the exact scenario `chaos_run.py --serve`
+drives). Each scenario runs a fault-free reference and a faulted pass of
+the same seeded trace and asserts the degradation invariants internally —
+engine alive, every page conserved, unaffected greedy streams bit-identical
+— so these tests mostly assert on the returned summary. The CLI JSON line
+is validated through the shared single-line parser at the end."""
+
+import json
+
+import pytest
+
+from midgpt_tpu.analysis.bench_contract import parse_single_json_line
+from midgpt_tpu.robustness import faults
+from midgpt_tpu.robustness.chaos_serve import run_serving_chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_chaos_kill_mid_decode_full_parity():
+    """A killed decode round recompute-preempts every decode-ready slot;
+    recovery is parity-preserving, so NO request may diverge."""
+    s = run_serving_chaos("kill_mid_decode@6", seed=0)
+    assert s["faults_fired"] == {"kill_mid_decode": 1}
+    assert s["decode_kills"] == 1
+    assert s["preemptions"] >= 1, "the kill must actually preempt someone"
+    assert s["statuses"] == {"ok": s["n_requests"]}
+    assert s["parity_checked"] == s["n_requests"]
+    assert s["parity_ok"] == s["parity_checked"]
+    assert s["pages_conserved"]
+
+
+def test_chaos_poisoned_page_isolates_the_victim():
+    """HBM damage to one slot's page corrupts at most that slot: every
+    other stream is bit-identical and the pool stays conserved."""
+    s = run_serving_chaos("poisoned_page@3", seed=0)
+    assert s["faults_fired"] == {"poisoned_page": 1}
+    assert s["poisoned"] == 1
+    assert s["parity_checked"] == s["n_requests"] - 1  # victim excluded
+    assert s["parity_ok"] == s["parity_checked"]
+    assert s["pages_conserved"]
+
+
+def test_chaos_slow_client_shed_without_collateral():
+    """A wedged streaming client is shed with status slow_client; the
+    engine keeps serving and the other clients' DELIVERED streams match
+    the reference."""
+    s = run_serving_chaos("slow_client@1", seed=0)
+    assert s["faults_fired"] == {"slow_client": 1}
+    assert s["statuses"].get("slow_client") == 1
+    assert s["cancelled"] == 1
+    assert s["statuses"].get("ok") == s["n_requests"] - 1
+    assert s["parity_ok"] == s["parity_checked"] == s["n_requests"] - 1
+    assert s["pages_conserved"]
+
+
+def test_chaos_submit_storm_sheds_and_survivors_finish():
+    """A burst of duplicate submissions beyond the backpressure budget
+    sheds (BackpressureError) instead of wedging the pool; whatever was
+    admitted serves to completion with exact streams."""
+    s = run_serving_chaos("submit_storm@2", seed=0)
+    assert s["faults_fired"] == {"submit_storm": 1}
+    assert s["shed"] >= 1, "the storm must overrun the backlog budget"
+    assert s["parity_ok"] == s["parity_checked"] >= 1
+    assert s["pages_conserved"]
+
+
+def test_chaos_run_serve_cli_emits_one_json_line(capsys):
+    """`chaos_run.py --serve` holds the one-JSON-line driver contract and
+    carries the chaos verdict fields."""
+    import runpy
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mod = runpy.run_path(
+        os.path.join(repo, "tools", "chaos_run.py"), run_name="chaos_under_test"
+    )
+    argv, sys.argv = sys.argv, [
+        "chaos_run.py", "--serve", "--fault", "kill_mid_decode@5",
+    ]
+    try:
+        rc = mod["main"]()
+    finally:
+        sys.argv = argv
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec, problems = parse_single_json_line(out)
+    assert not problems, problems
+    assert rec["tool"] == "chaos_run" and rec["mode"] == "serve"
+    assert rec["status"] == "ok"
+    assert rec["faults_fired"] == {"kill_mid_decode": 1}
+    assert rec["pages_conserved"] is True
+    # the record round-trips as strict JSON (no NaN etc.)
+    json.loads(out)

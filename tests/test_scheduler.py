@@ -1,0 +1,201 @@
+"""Pluggable scheduler policies (sampling/scheduler.py): FCFS preserves
+the PR 1 engine behavior (the extraction pin), the SLO policy implements
+EDF admission / most-slack preemption / infeasible-deadline shedding, and
+swapping policies compiles NOTHING — scheduling is host-side only."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.analysis.hlo_audit import CompileCounter
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.sampling.engine import generate
+from midgpt_tpu.sampling.scheduler import FCFSScheduler, SLOScheduler
+from midgpt_tpu.sampling.serve import BackpressureError, Request, ServeEngine
+
+CFG = GPTConfig(block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT.init(CFG, jax.random.PRNGKey(0))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _req(uid, deadline=None):
+    return Request(uid, np.zeros(4, np.int32), 8, None, deadline)
+
+
+@dataclasses.dataclass
+class _StubSlot:
+    admit_order: int
+    request: Request
+
+
+# ----------------------------------------------------------------------
+# policy units (no device work)
+# ----------------------------------------------------------------------
+
+
+def test_fcfs_policy_decisions():
+    s = FCFSScheduler()
+    assert s.select_admit([_req(0), _req(1)], now=0.0) == 0
+    assert s.select_admit([], now=0.0) is None
+    slots = [_StubSlot(3, _req(3)), _StubSlot(7, _req(7)), _StubSlot(5, _req(5))]
+    assert s.select_victim(_StubSlot(1, _req(1)), slots, now=0.0).admit_order == 7
+
+
+def test_slo_policy_edf_admission():
+    s = SLOScheduler()
+    queue = [_req(0, deadline=9.0), _req(1, deadline=3.0), _req(2, None)]
+    assert s.select_admit(queue, now=0.0) == 1  # earliest deadline first
+    # deadline-less requests rank last; ties fall back to queue position
+    assert s.select_admit([_req(0), _req(1)], now=0.0) == 0
+
+
+def test_slo_policy_most_slack_victim():
+    s = SLOScheduler()
+    requester = _StubSlot(1, _req(1, deadline=2.0))
+    tight = _StubSlot(4, _req(4, deadline=5.0))
+    loose = _StubSlot(3, _req(3, deadline=50.0))
+    assert s.select_victim(requester, [tight, loose], now=0.0) is loose
+    # a deadline-less candidate has infinite slack: evicted before any
+    # deadline-bearing one, youngest first among themselves
+    free_a = _StubSlot(2, _req(2, None))
+    free_b = _StubSlot(6, _req(6, None))
+    assert s.select_victim(requester, [tight, free_a, free_b], now=0.0) is free_b
+
+
+def test_slo_policy_sheds_infeasible_deadline(params):
+    """A deadline closer than min_headroom_s sheds at submit with
+    retryable=False — waiting cannot un-miss an SLO — while a comfortable
+    deadline admits."""
+    clock = FakeClock()
+    eng = ServeEngine(
+        CFG, params, max_slots=1, num_pages=17, cache_dtype=jnp.float32,
+        scheduler=SLOScheduler(min_headroom_s=1.0), clock=clock,
+    )
+    with pytest.raises(BackpressureError) as ei:
+        eng.submit(np.arange(4, dtype=np.int32), 4, ttl_s=0.5)
+    assert not ei.value.retryable
+    assert eng.shed == 1
+    uid = eng.submit(np.arange(4, dtype=np.int32), 4, ttl_s=10.0)
+    assert eng.run()[uid].status == "ok"
+
+
+# ----------------------------------------------------------------------
+# end-to-end: behavior preservation and the zero-new-compile pin
+# ----------------------------------------------------------------------
+
+
+def _mixed_trace(seed=0, lengths=(25, 34, 47), max_new=(9, 17, 17)):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+        for n, m in zip(lengths, max_new)
+    ]
+
+
+def _run_engine(params, scheduler, trace, ttls=None):
+    eng = ServeEngine(
+        CFG, params, max_slots=3, page_size=8, num_pages=25,
+        prefill_chunk=16, decode_chunk=8, temperature=0.0,
+        cache_dtype=jnp.float32, scheduler=scheduler,
+    )
+    uids = [
+        eng.submit(p, m, ttl_s=None if ttls is None else ttls[i])
+        for i, (p, m) in enumerate(trace)
+    ]
+    return eng, uids, eng.run()
+
+
+def test_slo_policy_keeps_greedy_parity_and_compiles_nothing(params):
+    """The tentpole pin: request streams are schedule-INDEPENDENT (greedy
+    tokens depend only on the prompt), so the SLO policy must reproduce
+    `generate` per request token-for-token — and because scheduling is
+    pure host code, running a new policy after an FCFS warm run compiles
+    ZERO programs (tests/test_recompile_pins.py methodology)."""
+    trace = _mixed_trace()
+    _run_engine(params, FCFSScheduler(), trace)  # warm the program set
+    with CompileCounter() as cc:
+        _, uids, done = _run_engine(
+            params, SLOScheduler(), trace, ttls=(60.0, 1.0e4, None)
+        )
+    assert cc.count == 0, f"policy swap compiled {cc.count} program(s)"
+    for (p, m), u in zip(trace, uids):
+        ref = generate(CFG, params, jnp.asarray(p)[None], m, temperature=0.0)
+        np.testing.assert_array_equal(
+            done[u].tokens, np.asarray(ref[0]), err_msg=f"request {u}"
+        )
+
+
+def test_slo_policy_preempts_most_slack_slot_under_pressure(params):
+    """On an oversubscribed pool the SLO engine evicts the younger slot
+    with the MOST deadline slack: the urgent request streams through
+    unpreempted while the relaxed one eats the recompute."""
+    class Recording(SLOScheduler):
+        def __init__(self):
+            super().__init__()
+            self.victim_uids = []
+
+        def select_victim(self, requester, candidates, now):
+            v = super().select_victim(requester, candidates, now)
+            if v is not None:
+                self.victim_uids.append(v.request.uid)
+            return v
+
+    clock = FakeClock()
+    sched = Recording()
+    eng = ServeEngine(
+        CFG, params, max_slots=3, page_size=8, num_pages=10,
+        prefill_chunk=16, decode_chunk=8, temperature=0.0,
+        cache_dtype=jnp.float32, scheduler=sched, clock=clock,
+    )
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, CFG.vocab_size, 8).astype(np.int32) for _ in range(3)]
+    u_old = eng.submit(prompts[0], 30)
+    u_urgent = eng.submit(prompts[1], 30, ttl_s=1e6)  # huge but finite TTL
+    u_loose = eng.submit(prompts[2], 30)  # deadline-less: infinite slack
+    done = eng.run()
+    assert eng.preemptions >= 1, "pool was sized to force preemption"
+    # the urgent (finite-deadline) request is never the chosen victim while
+    # a deadline-less slot is on the table
+    assert u_urgent not in sched.victim_uids
+    assert u_loose in sched.victim_uids
+    # every stream still exact (recompute preemption is parity-preserving)
+    for u, p in ((u_old, prompts[0]), (u_urgent, prompts[1]), (u_loose, prompts[2])):
+        ref = generate(CFG, params, jnp.asarray(p)[None], 30, temperature=0.0)
+        np.testing.assert_array_equal(done[u].tokens, np.asarray(ref[0]))
+
+
+def test_custom_scheduler_victim_contract_enforced(params):
+    """A policy returning a victim outside the offered (strictly younger)
+    candidate set is a contract violation — the engine refuses instead of
+    breaking deadlock-freedom."""
+
+    class Rogue(FCFSScheduler):
+        def select_victim(self, requester, candidates, now):
+            return requester  # never a candidate: candidates exclude it
+
+    eng = ServeEngine(
+        CFG, params, max_slots=2, page_size=8, num_pages=6,
+        temperature=0.0, cache_dtype=jnp.float32, scheduler=Rogue(),
+    )
+    rng = np.random.default_rng(6)
+    eng.submit(rng.integers(0, CFG.vocab_size, 8).astype(np.int32), 20)
+    eng.submit(rng.integers(0, CFG.vocab_size, 8).astype(np.int32), 20)
+    with pytest.raises(RuntimeError, match="non-candidate victim"):
+        eng.run()

@@ -1,0 +1,236 @@
+"""Async streaming front door (sampling/server.py): per-token streaming
+with greedy parity, mid-stream client cancellation, bounded backpressure
+retry, slow-client shedding, and graceful drain via the PR 3 one-shot
+preemption flag. All asyncio tests run through asyncio.run inside plain
+pytest functions (no plugin dependency); determinism comes from the
+engine's greedy mode and the seeded prompts, not from timing."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.robustness import preempt
+from midgpt_tpu.sampling.engine import generate
+from midgpt_tpu.sampling.serve import ServeEngine
+from midgpt_tpu.sampling.server import AsyncServeServer, ServerDraining
+
+CFG = GPTConfig(block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT.init(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, **kw):
+    base = dict(
+        max_slots=2, page_size=8, num_pages=33, prefill_chunk=16,
+        decode_chunk=4, temperature=0.0, cache_dtype=jnp.float32,
+    )
+    base.update(kw)
+    return ServeEngine(CFG, params, **base)
+
+
+def _prompts(n, seed=0, lo=4, hi=24):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, CFG.vocab_size, int(rng.integers(lo, hi))).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def test_stream_tokens_match_generate(params):
+    """Streamed tokens are exactly the greedy generation, delivered
+    incrementally, and the terminal record carries status ok."""
+    p1, p2 = _prompts(2, seed=1)
+    eng = _engine(params)
+
+    async def main():
+        server = AsyncServeServer(eng, idle_poll_s=0.001)
+        driver = asyncio.create_task(server.run())
+
+        async def client(p, m):
+            uid = await server.submit(p, m)
+            toks = []
+            async for tok in server.stream(uid):
+                toks.append(tok)
+            return uid, toks
+
+        (u1, t1), (u2, t2) = await asyncio.gather(client(p1, 10), client(p2, 8))
+        await server.drain()
+        await driver
+        return {u1: (p1, 10, t1), u2: (p2, 8, t2)}
+
+    results = asyncio.run(main())
+    for uid, (p, m, toks) in results.items():
+        ref = np.asarray(
+            generate(CFG, params, jnp.asarray(p)[None], m, temperature=0.0)
+        )[0]
+        np.testing.assert_array_equal(np.asarray(toks), ref[len(p):])
+        fr = eng.finished[uid]
+        assert fr.status == "ok"
+        np.testing.assert_array_equal(fr.tokens, ref)
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1
+
+
+def test_client_disconnect_cancels_and_frees_pages(params):
+    """Abandoning a stream mid-decode cancels the request at the next
+    round boundary: pages conserved, bystander stream exact, status
+    'cancelled' with the delivered prefix intact."""
+    p_victim, p_by = _prompts(2, seed=2)
+    eng = _engine(params)
+
+    async def main():
+        server = AsyncServeServer(eng, idle_poll_s=0.001)
+        driver = asyncio.create_task(server.run())
+
+        async def bystander():
+            uid = await server.submit(p_by, 12)
+            toks = [tok async for tok in server.stream(uid)]
+            return uid, toks
+
+        async def victim():
+            uid = await server.submit(p_victim, 20)
+            got = []
+            async for tok in server.stream(uid):
+                got.append(tok)
+                if len(got) == 3:
+                    break  # client walks away mid-stream
+            return uid, got
+
+        (u_by, t_by), (u_v, t_v) = await asyncio.gather(bystander(), victim())
+        await server.drain()
+        await driver
+        return u_by, t_by, u_v, t_v
+
+    u_by, t_by, u_v, t_v = asyncio.run(main())
+    ref_by = np.asarray(
+        generate(CFG, params, jnp.asarray(p_by)[None], 12, temperature=0.0)
+    )[0]
+    np.testing.assert_array_equal(np.asarray(t_by), ref_by[len(p_by):])
+    fr = eng.finished[u_v]
+    assert fr.status == "cancelled"
+    ref_v = np.asarray(
+        generate(CFG, params, jnp.asarray(p_victim)[None], 20, temperature=0.0)
+    )[0]
+    # the client consumed a prefix of the true greedy stream before leaving
+    np.testing.assert_array_equal(
+        np.asarray(t_v), ref_v[len(p_victim):len(p_victim) + len(t_v)]
+    )
+    assert eng.cancelled == 1
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1
+
+
+def test_submit_backpressure_retry_succeeds_when_capacity_frees(params):
+    """A retryable BackpressureError is absorbed by the bounded backoff:
+    the second submit initially exceeds the backlog budget, then admits on
+    a retry once the first request finishes."""
+    p = np.arange(10, dtype=np.int32)
+    eng = _engine(params, max_slots=1, max_backlog_pages=2)
+
+    async def main():
+        server = AsyncServeServer(
+            eng, submit_retries=8, retry_backoff_s=0.02, idle_poll_s=0.001
+        )
+        driver = asyncio.create_task(server.run())
+        u1 = await server.submit(p, 6)  # 2 pages: fills the whole budget
+
+        async def consume(uid):
+            return [tok async for tok in server.stream(uid)]
+
+        c1 = asyncio.create_task(consume(u1))
+        u2 = await server.submit(p, 6)  # sheds, backs off, then admits
+        c2 = asyncio.create_task(consume(u2))
+        t1, t2 = await asyncio.gather(c1, c2)
+        await server.drain()
+        await driver
+        return u1, t1, u2, t2
+
+    u1, t1, u2, t2 = asyncio.run(main())
+    assert eng.shed >= 1, "the second submit must have been shed at least once"
+    ref = np.asarray(
+        generate(CFG, params, jnp.asarray(p)[None], 6, temperature=0.0)
+    )[0]
+    for toks in (t1, t2):
+        np.testing.assert_array_equal(np.asarray(toks), ref[len(p):])
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1
+
+
+def test_drain_via_preempt_flag_rejects_new_work(params):
+    """SIGTERM path: the PR 3 one-shot preemption flag (driven directly,
+    robustness/preempt.py test convention) flips the server into draining —
+    in-flight requests finish, new submits raise ServerDraining, run()
+    returns."""
+    p1, p2 = _prompts(2, seed=3)
+    eng = _engine(params)
+    preempt.reset()
+
+    async def main():
+        server = AsyncServeServer(eng, idle_poll_s=0.001)
+        driver = asyncio.create_task(server.run())
+        u1 = await server.submit(p1, 12)
+        stream = server.stream(u1)
+        first = await stream.__anext__()
+        preempt.request()  # what the SIGTERM handler does
+        toks = [first] + [tok async for tok in stream]
+        with pytest.raises(ServerDraining):
+            await server.submit(p2, 4)
+        await asyncio.wait_for(driver, timeout=30)
+        assert server.draining
+        return u1, toks
+
+    try:
+        u1, toks = asyncio.run(main())
+    finally:
+        preempt.reset()
+    ref = np.asarray(
+        generate(CFG, params, jnp.asarray(p1)[None], 12, temperature=0.0)
+    )[0]
+    np.testing.assert_array_equal(np.asarray(toks), ref[len(p1):])
+    assert eng.finished[u1].status == "ok"
+
+
+def test_slow_client_is_shed_not_served_forever(params):
+    """The slow_client fault (step key = uid) wedges one stream; the
+    bounded per-client buffer sheds exactly that request with status
+    'slow_client' while the bystander streams to completion."""
+    from midgpt_tpu.robustness import faults
+
+    p_slow, p_by = _prompts(2, seed=4)
+    eng = _engine(params)
+    faults.clear()
+
+    async def main():
+        # bound must exceed a decode-chunk burst (tokens land per ROUND,
+        # so a healthy consumer can briefly hold chunk-many undrained)
+        server = AsyncServeServer(
+            eng, max_buffered_tokens=8, idle_poll_s=0.001
+        )
+        driver = asyncio.create_task(server.run())
+        u_slow = await server.submit(p_slow, 16)
+        faults.activate("slow_client", step=u_slow)
+        u_by = await server.submit(p_by, 10)
+
+        async def consume(uid):
+            return [tok async for tok in server.stream(uid)]
+
+        t_slow, t_by = await asyncio.gather(consume(u_slow), consume(u_by))
+        await server.drain()
+        await driver
+        return u_slow, t_slow, u_by, t_by
+
+    try:
+        u_slow, t_slow, u_by, t_by = asyncio.run(main())
+    finally:
+        faults.clear()
+    assert eng.finished[u_slow].status == "slow_client"
+    assert t_slow == []  # the wedged stream delivered nothing after stalling
+    ref = np.asarray(
+        generate(CFG, params, jnp.asarray(p_by)[None], 10, temperature=0.0)
+    )[0]
+    np.testing.assert_array_equal(np.asarray(t_by), ref[len(p_by):])
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1

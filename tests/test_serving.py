@@ -200,43 +200,61 @@ def test_serve_stochastic_sampling_runs(params):
         assert (out >= 0).all() and (out < CFG.vocab_size).all()
 
 
+class FakeClock:
+    """Injectable engine clock (satellite): TTL tests advance time
+    explicitly instead of racing wall-clock sleeps on the 1-core host."""
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
 def test_serve_request_ttl_timeout(params):
     """Satellite (robustness PR): a deadline-expired request is finished
     with status='timeout' (partial tokens returned, pages freed) instead of
-    occupying the pool forever — queued and running requests alike."""
-    from midgpt_tpu.sampling.serve import BackpressureError  # noqa: F401
-
+    occupying the pool forever — queued and running requests alike. Driven
+    entirely by the injectable clock: zero sleeps, zero flakiness."""
+    clock = FakeClock()
     eng = ServeEngine(
         CFG, params, max_slots=1, page_size=8, num_pages=17,
-        prefill_chunk=16, cache_dtype=jnp.float32,
+        prefill_chunk=16, cache_dtype=jnp.float32, clock=clock,
     )
     p = np.arange(5, dtype=np.int32)
-    # queued + already expired: cleared by the next round's expiry pass
-    u_dead = eng.submit(p, 8, ttl_s=0.0)
-    u_live = eng.submit(p, 8)
+    u_dead = eng.submit(p, 8, ttl_s=5.0)
+    u_live = eng.submit(p, 8)  # no TTL: immune to the clock jump
+    clock.advance(10.0)  # u_dead expires while still queued
     done = eng.run()
     assert done[u_dead].status == "timeout"
     assert len(done[u_dead].tokens) == len(p)  # nothing generated
     assert done[u_live].status == "ok"
     assert len(done[u_live].tokens) == len(p) + 8
+    assert eng.timeouts == 1
     assert eng.allocator.free_count == eng.allocator.num_pages - 1  # all freed
 
     # running slot: expire mid-generation -> partial tokens, pages freed
+    clock2 = FakeClock()
     eng2 = ServeEngine(
         CFG, params, max_slots=1, page_size=8, num_pages=17,
         prefill_chunk=16, decode_chunk=1, cache_dtype=jnp.float32,
+        clock=clock2,
     )
     u = eng2.submit(p, 12, ttl_s=60.0)
     for _ in range(3):
-        eng2.step()  # prefill + a couple of decode rounds
+        eng2.step()  # prefill + a couple of decode rounds, well inside TTL
     slot = next(s for s in eng2.slots if s is not None)
     n_before = len(slot.generated)
     assert 0 < n_before < 12
-    slot.request.deadline = 0.0  # force expiry deterministically
+    clock2.advance(61.0)  # sail past the deadline, deterministically
     eng2.step()
     assert eng2.slots[0] is None and u in eng2.finished
     assert eng2.finished[u].status == "timeout"
     assert len(eng2.finished[u].tokens) == len(p) + n_before
+    assert eng2.timeouts == 1
     assert eng2.allocator.free_count == eng2.allocator.num_pages - 1
 
 
@@ -259,3 +277,139 @@ def test_serve_backpressure_admission(params):
     assert done[u1].status == "ok" and done[u2].status == "ok"
     u3 = eng.submit(p, 6)  # backlog drained: admission works again
     assert eng.run()[u3].status == "ok"
+
+
+def test_backpressure_error_structured_fields(params):
+    """Satellite: BackpressureError carries the retry ergonomics as fields
+    (needed/backlog/budget pages, retry_after_pages, retryable) so the
+    async server backs off on data instead of string-parsing messages."""
+    from midgpt_tpu.sampling.serve import BackpressureError
+
+    eng = ServeEngine(
+        CFG, params, max_slots=2, page_size=8, num_pages=17,
+        prefill_chunk=16, cache_dtype=jnp.float32, max_backlog_pages=4,
+    )
+    p = np.arange(10, dtype=np.int32)  # 10 + 6 tokens -> 2 pages worst case
+    eng.submit(p, 6)
+    eng.submit(p, 6)
+    with pytest.raises(BackpressureError) as ei:
+        eng.submit(p, 6)
+    e = ei.value
+    assert e.needed_pages == 2
+    assert e.backlog_pages == 4
+    assert e.budget_pages == 4
+    assert e.retry_after_pages == 2  # pages that must free before retry
+    assert e.retryable  # capacity sheds are retryable (deadline sheds not)
+    assert eng.shed == 1
+
+
+def _co_resident_pair(params, **kw):
+    """Two-slot engine plus a long victim prompt and a short bystander
+    prompt; returns (eng, p_victim, p_bystander)."""
+    rng = np.random.default_rng(11)
+    p_victim = rng.integers(0, CFG.vocab_size, 48).astype(np.int32)
+    p_by = rng.integers(0, CFG.vocab_size, 7).astype(np.int32)
+    eng = ServeEngine(
+        CFG, params, max_slots=2, page_size=8, num_pages=33,
+        prefill_chunk=16, decode_chunk=4, temperature=0.0,
+        cache_dtype=jnp.float32, **kw,
+    )
+    return eng, p_victim, p_by
+
+
+def _assert_bystander_exact(eng, u_by, p_by, m_by, params):
+    ref = generate(CFG, params, jnp.asarray(p_by)[None], m_by, temperature=0.0)
+    np.testing.assert_array_equal(
+        eng.finished[u_by].tokens, np.asarray(ref[0]),
+        err_msg="cancellation perturbed a co-resident slot",
+    )
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1
+
+
+def test_cancel_during_prefill_conserves_pages(params):
+    """Satellite: client disconnect while the victim is STILL MID-PROMPT —
+    its chunk-held pages return to the pool, nothing was generated, and the
+    co-resident decode stream is untouched."""
+    eng, p_victim, p_by = _co_resident_pair(params)
+    u_by = eng.submit(p_by, 10)
+    u_victim = eng.submit(p_victim, 8)
+    eng.step()  # victim prefilled one chunk of three; bystander decodes
+    slot = next(
+        s for s in eng.slots if s is not None and s.request.uid == u_victim
+    )
+    assert slot.prefilling and slot.pages, "victim must be mid-prefill"
+    assert eng.cancel(u_victim)
+    assert eng.finished[u_victim].status == "cancelled"
+    assert len(eng.finished[u_victim].tokens) == len(p_victim)  # prompt only
+    eng.run()
+    _assert_bystander_exact(eng, u_by, p_by, 10, params)
+    assert not eng.cancel(u_victim)  # already finished: no-op
+
+
+def test_cancel_during_decode_conserves_pages(params):
+    """Satellite: disconnect mid-DECODE — partial tokens recorded, pages
+    freed, bystander exact."""
+    eng, p_victim, p_by = _co_resident_pair(params)
+    u_by = eng.submit(p_by, 12)
+    u_victim = eng.submit(p_victim[:9], 20)
+    for _ in range(4):
+        eng.step()
+    slot = next(
+        s for s in eng.slots if s is not None and s.request.uid == u_victim
+    )
+    n_gen = len(slot.generated)
+    assert 0 < n_gen < 20, "victim must be mid-decode"
+    assert eng.cancel(u_victim)
+    fr = eng.finished[u_victim]
+    assert fr.status == "cancelled" and len(fr.tokens) == 9 + n_gen
+    # the delivered prefix is exactly the greedy stream (no corruption)
+    ref = generate(CFG, params, jnp.asarray(p_victim[:9])[None], 20,
+                   temperature=0.0)
+    np.testing.assert_array_equal(fr.tokens, np.asarray(ref[0])[: 9 + n_gen])
+    eng.run()
+    _assert_bystander_exact(eng, u_by, p_by, 12, params)
+
+
+def test_cancel_during_spec_rounds_conserves_pages(params):
+    """Satellite: disconnect between SPECULATIVE verify rounds of a
+    self-draft engine — rollback bookkeeping must not leak the victim's
+    pages nor perturb the co-resident stream (greedy spec serving is
+    token-identical to generate, tests/test_spec.py)."""
+    from midgpt_tpu.sampling.spec import self_draft
+
+    dcfg, dparams = self_draft(CFG, params, 1)
+    eng, p_victim, p_by = _co_resident_pair(
+        params,
+        draft_params=dparams, draft_config=dcfg, draft_shares_cache=True,
+        spec_k_max=4, spec_k_min=4, spec_adapt=False,
+    )
+    u_by = eng.submit(p_by, 12)
+    u_victim = eng.submit(p_victim[:9], 20)
+    for _ in range(4):
+        eng.step()
+    slot = next(
+        s for s in eng.slots if s is not None and s.request.uid == u_victim
+    )
+    assert len(slot.generated) > 0, "victim must be mid-speculation"
+    assert eng._spec_rounds > 0, "engine must actually be speculating"
+    assert eng.cancel(u_victim)
+    eng.run()
+    assert eng.finished[u_victim].status == "cancelled"
+    _assert_bystander_exact(eng, u_by, p_by, 12, params)
+
+
+def test_cancel_queued_request(params):
+    """Cancelling a request that never reached a slot frees nothing but
+    still records the terminal status (and FCFS admission skips it)."""
+    eng = ServeEngine(
+        CFG, params, max_slots=1, num_pages=17, cache_dtype=jnp.float32,
+    )
+    p = np.arange(5, dtype=np.int32)
+    u1 = eng.submit(p, 6)
+    u2 = eng.submit(p, 6)  # queued behind u1 (one slot)
+    assert eng.cancel(u2)
+    assert eng.finished[u2].status == "cancelled"
+    done = eng.run()
+    assert done[u1].status == "ok"
+    assert eng.cancelled == 1
+    assert eng.allocator.free_count == eng.allocator.num_pages - 1

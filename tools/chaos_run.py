@@ -1,14 +1,26 @@
-"""Chaos harness: a supervised training run with injected faults, one JSON
-summary line (the driver contract bench.py established).
+"""Chaos harness: injected faults against the REAL recovery paths, one
+JSON summary line (the driver contract bench.py established).
+
+Training mode (PR 3) — a supervised run through the real rollback/retry/
+verification machinery:
 
     python tools/chaos_run.py --config=shakespeare_char --rundir=/tmp/chaos \
         --fault nan_grad@12 --fault ckpt_io_error*2 \
         [--set max_steps=40 ...] [--max-restarts 3]
 
-Runs `robustness.supervisor.supervise` end to end — the REAL recovery path
-(rollback, window skip, checkpoint retry, manifest verification), not a
-mock — and reports what fired and what it cost. Fault spec grammar:
-`kind[@step][*times]` (robustness/faults.py; MIDGPT_FAULTS env works too).
+Serving mode (`--serve`) — a seeded request trace through the continuous-
+batching engine (and, for client faults, the async front door) with one of
+the serving fault kinds armed; asserts graceful degradation (engine alive,
+pages conserved, unaffected greedy streams bit-identical to a fault-free
+run — robustness/chaos_serve.py) and reports shed/timeout counts:
+
+    python tools/chaos_run.py --serve --fault kill_mid_decode@6
+    python tools/chaos_run.py --serve --fault poisoned_page@8 --fault slow_client@1
+
+Fault spec grammar: `kind[@step][*times]` (robustness/faults.py;
+MIDGPT_FAULTS env works too). Serving step keys: engine round for
+kill_mid_decode/poisoned_page, victim uid for slow_client, arrival index
+for submit_storm.
 
 Platform selection follows launch.py: set MIDGPT_PLATFORM=cpu (and
 MIDGPT_CPU_DEVICES=8) to drive recovery scenarios on the virtual CPU mesh.
@@ -37,10 +49,41 @@ def _load_launch():
     return mod
 
 
+def _serve_main(args) -> int:
+    """--serve: one serving chaos scenario, one JSON line. A broken
+    degradation invariant (AssertionError) is the chaos verdict — reported
+    as data with a nonzero exit, same contract as training mode."""
+    from midgpt_tpu.robustness.chaos_serve import run_serving_chaos
+
+    t0 = time.time()
+    status = "ok"
+    error = None
+    result: dict = {}
+    try:
+        result = run_serving_chaos(
+            ",".join(args.fault), seed=args.seed, n_requests=args.n_requests
+        )
+    except AssertionError as e:
+        status = "failed"
+        error = str(e)
+    summary = {
+        "tool": "chaos_run",
+        "mode": "serve",
+        "status": status,
+        "wall_s": round(time.time() - t0, 3),
+        "faults_requested": args.fault,
+        **result,
+    }
+    if error is not None:
+        summary["error"] = error
+    print(json.dumps(summary))
+    return 0 if status == "ok" else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--config", type=str, required=True)
-    parser.add_argument("--rundir", type=str, required=True)
+    parser.add_argument("--config", type=str, default=None)
+    parser.add_argument("--rundir", type=str, default=None)
     parser.add_argument(
         "--fault",
         action="append",
@@ -53,6 +96,16 @@ def main() -> int:
         "--set", action="append", default=[], metavar="KEY=VALUE",
         help="dotted config override (same semantics as launch.py)",
     )
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="serving chaos: drive a seeded trace through the continuous-"
+        "batching engine with the armed faults (robustness/chaos_serve.py) "
+        "instead of a supervised training run",
+    )
+    parser.add_argument("--seed", type=int, default=0,
+                        help="--serve: trace/model seed")
+    parser.add_argument("--n-requests", type=int, default=5,
+                        help="--serve: requests in the seeded trace")
     args = parser.parse_args()
 
     import jax
@@ -63,6 +116,11 @@ def main() -> int:
             from midgpt_tpu.utils.compat import set_cpu_device_count
 
             set_cpu_device_count(int(os.environ["MIDGPT_CPU_DEVICES"]))
+
+    if args.serve:
+        return _serve_main(args)
+    if args.config is None or args.rundir is None:
+        parser.error("--config and --rundir are required (unless --serve)")
 
     from midgpt_tpu.config import load_config
     from midgpt_tpu.robustness import faults, preempt
