@@ -171,6 +171,71 @@ def check_serve_spec_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_prefix_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --shared-prefix-frac profile: the template
+    workload run cache-off then cache-on at the same page budget (field
+    table: docs/SERVING.md 'Prefix cache'). The load-bearing invariant is
+    greedy_match_frac == 1.0 EXACTLY — prefix sharing is page-table
+    indirection over bit-identical K/V, so any mismatch at all means a
+    torn page, not noise — which makes it a schema check, not a quality
+    threshold."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "shared_prefix_frac": Number,
+            "n_templates": (int,),
+            "template_tokens": (int,),
+            "kv_dtype": (str,),
+            "num_pages": (int,),
+            "model": (dict,),
+            "baseline_tok_s": Number,
+            "prefix_tok_s": Number,
+            "speedup_prefix": Number,
+            "baseline_ttft_ms_p50": Number,
+            "baseline_ttft_ms_p95": Number,
+            "prefix_ttft_ms_p50": Number,
+            "prefix_ttft_ms_p95": Number,
+            "prefix_hit_rate": Number,
+            "cow_pages": (int,),
+            "baseline_prefill_tokens": (int,),
+            "prefix_prefill_tokens": (int,),
+            "baseline_preemptions": (int,),
+            "prefix_preemptions": (int,),
+            "trie_pages": (int,),
+            "reclaimed_pages": (int,),
+            "greedy_match_frac": Number,
+            "cache_hbm_bytes": (int,),
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_prefix":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_prefix'"
+        )
+    hr = rec.get("prefix_hit_rate")
+    if isinstance(hr, Number) and not 0.0 <= hr <= 1.0:
+        problems.append(f"prefix_hit_rate {hr} outside [0, 1]")
+    gmf = rec.get("greedy_match_frac")
+    if isinstance(gmf, Number) and gmf != 1.0:
+        problems.append(
+            f"greedy_match_frac {gmf} != 1.0 — prefix sharing must be "
+            "bit-invisible to greedy streams"
+        )
+    pf = rec.get("prefix_prefill_tokens")
+    bf = rec.get("baseline_prefill_tokens")
+    if isinstance(pf, int) and isinstance(bf, int) and pf > bf:
+        problems.append(
+            f"prefix run prefilled MORE tokens than baseline ({pf} > {bf})"
+        )
+    return problems
+
+
 def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     """tools/loadgen.py profile: TTFT/TPOT percentiles + shed fraction
     under a seeded arrival process, at >= 2 offered-load points (one point
@@ -237,7 +302,8 @@ def check_serve_slo_bench(rec: dict) -> tp.List[str]:
                 pp,
             )
             problems.extend(f"points[{i}]: {q}" for q in pp)
-            for frac in ("shed_frac", "timeout_frac"):
+            # optional: present when loadgen ran with --prefix-cache
+            for frac in ("shed_frac", "timeout_frac", "prefix_hit_rate"):
                 v = p.get(frac)
                 if isinstance(v, Number) and not 0.0 <= v <= 1.0:
                     problems.append(f"points[{i}].{frac} {v} outside [0, 1]")
@@ -277,6 +343,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "train": check_train_bench,
     "serve": check_serve_bench,
     "serve_spec": check_serve_spec_bench,
+    "serve_prefix": check_serve_prefix_bench,
     "serve_slo": check_serve_slo_bench,
     "graftcheck": check_graftcheck,
 }

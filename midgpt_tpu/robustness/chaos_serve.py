@@ -56,17 +56,35 @@ def _tiny_model(seed: int):
     return cfg, GPT.init(cfg, jax.random.PRNGKey(seed))
 
 
-def _trace(cfg, seed: int, n_requests: int):
+def _trace(cfg, seed: int, n_requests: int, shared: bool = False):
     rng = np.random.default_rng(seed)
     out = []
-    for _ in range(n_requests):
-        t0 = int(rng.integers(4, 24))
-        m = int(rng.integers(6, 16))
-        out.append((rng.integers(0, cfg.vocab_size, t0).astype(np.int32), m))
+    # `shared` (the evict_shared_prefix scenario): template-heavy traffic —
+    # two 16-token system prompts with short unique tails — so the prefix
+    # trie holds HOT shared nodes for the fault to flush. Drawn only in
+    # shared mode: the plain scenarios' seeded traces must stay the exact
+    # RNG stream their step-keyed fault plans were tuned against.
+    templates = [
+        rng.integers(0, cfg.vocab_size, 16).astype(np.int32) for _ in range(2)
+    ] if shared else []
+    for i in range(n_requests):
+        if shared:
+            tail = rng.integers(
+                0, cfg.vocab_size, int(rng.integers(2, 6))
+            ).astype(np.int32)
+            prompt = np.concatenate([templates[i % 2], tail])
+            m = int(rng.integers(6, 16))
+        else:
+            # draw order (t0, m, prompt) is load-bearing: the plain
+            # scenarios' step-keyed fault plans were tuned against it
+            t0 = int(rng.integers(4, 24))
+            m = int(rng.integers(6, 16))
+            prompt = rng.integers(0, cfg.vocab_size, t0).astype(np.int32)
+        out.append((prompt, m))
     return out
 
 
-def _engine(cfg, params, *, max_backlog_pages=None, clock=None):
+def _engine(cfg, params, *, max_backlog_pages=None, clock=None, prefix=False):
     import jax.numpy as jnp
 
     from midgpt_tpu.sampling.serve import ServeEngine
@@ -89,6 +107,7 @@ def _engine(cfg, params, *, max_backlog_pages=None, clock=None):
         temperature=0.0,
         cache_dtype=jnp.float32,
         max_backlog_pages=max_backlog_pages,
+        prefix_cache=prefix,
         **kw,
     )
 
@@ -158,14 +177,18 @@ def run_serving_chaos(
     `chaos_run.py --serve` emits as its JSON line. Raises AssertionError
     when a degradation invariant breaks — that IS the chaos verdict."""
     cfg, params = _tiny_model(seed)
-    trace = _trace(cfg, seed + 1, n_requests)
     uses_server = "slow_client" in fault_plan
     uses_storm = "submit_storm" in fault_plan
+    # The trie-flush fault needs a trie: both passes run with the prefix
+    # cache ON over a template-shared trace, so the reference pass also
+    # proves the cache itself is parity-clean before the flush is judged.
+    uses_prefix = "evict_shared_prefix" in fault_plan
+    trace = _trace(cfg, seed + 1, n_requests, shared=uses_prefix)
 
     # Fault-free reference pass (also warms every jit shape, so the fault
     # pass's timings/timeouts cannot hinge on compile stalls).
     faults.clear()
-    ref = _engine(cfg, params)
+    ref = _engine(cfg, params, prefix=uses_prefix)
     ref_uids, _ = _run_plain(ref, trace, storm=False)
     ref_tokens = {
         idx: np.asarray(ref.finished[uid].tokens)
@@ -177,6 +200,7 @@ def run_serving_chaos(
     eng = _engine(
         cfg, params,
         max_backlog_pages=STORM_BACKLOG_PAGES if uses_storm else None,
+        prefix=uses_prefix,
     )
     delivered: tp.Optional[tp.Dict[int, tp.List[int]]] = None
     storm_shed = 0
@@ -188,12 +212,22 @@ def run_serving_chaos(
     faults.clear()
 
     # -- invariant 2: page conservation + engine still serviceable -------
+    # With the prefix cache on, pages the trie retains for future matches
+    # are accounted alongside the free list (every one of them must be
+    # unreferenced once the engine drains — a dangling refcount would be a
+    # leak in waiting).
     assert eng.idle, "engine left work behind"
-    conserved = eng.allocator.free_count == eng.allocator.num_pages - 1
+    trie_pages = 0 if eng.prefix_cache is None else eng.prefix_cache.page_count()
+    conserved = (
+        eng.allocator.free_count + trie_pages == eng.allocator.num_pages - 1
+    )
     assert conserved, (
-        f"page leak: {eng.allocator.free_count} free of "
+        f"page leak: {eng.allocator.free_count} free + {trie_pages} trie of "
         f"{eng.allocator.num_pages - 1} allocatable"
     )
+    if eng.prefix_cache is not None:
+        dangling = eng.prefix_cache.referenced_page_count()
+        assert dangling == 0, f"{dangling} trie refcount(s) outlived the drain"
 
     # -- invariant 3: unaffected greedy streams are bit-identical --------
     affected = set(eng.poisoned_uids)
@@ -240,4 +274,7 @@ def run_serving_chaos(
         "parity_checked": parity_checked,
         "parity_ok": parity_ok,
         "pages_conserved": conserved,
+        "prefix_cache": eng.prefix_cache is not None,
+        "prefix_reclaimed": eng.prefix_evictions,
+        "prefix_hit_rate": eng.prefix_stats()["hit_rate"],
     }

@@ -49,6 +49,14 @@ firing deterministic):
                      backpressure budget; admission must shed the excess
                      (BackpressureError) and serve the admitted rest to
                      completion.
+  evict_shared_prefix  force-reclaim every unreferenced prefix-cache trie
+                     page at once (a pressure spike flushing hot shared
+                     nodes, LRU protection ignored); referenced entries
+                     must survive — a shared node is never evicted out
+                     from under a live reader — so live streams stay
+                     bit-identical while later requests just re-prefill
+                     and re-populate the trie, with pages + refcounts
+                     conserved through the flush.
 
 Activation: programmatic (`activate(...)`), or a plan string from config
 (`ExperimentConfig.fault_plan`) / the MIDGPT_FAULTS env var, parsed by
@@ -75,6 +83,7 @@ KINDS = (
     "poisoned_page",
     "slow_client",
     "submit_storm",
+    "evict_shared_prefix",
 )
 
 _PLAN_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$")

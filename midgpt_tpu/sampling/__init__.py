@@ -1,4 +1,5 @@
 from midgpt_tpu.sampling.engine import generate
+from midgpt_tpu.sampling.prefix_cache import MatchResult, PrefixCache
 from midgpt_tpu.sampling.scheduler import FCFSScheduler, Scheduler, SLOScheduler
 from midgpt_tpu.sampling.serve import BackpressureError, ServeEngine
 from midgpt_tpu.sampling.server import AsyncServeServer, ServerDraining
@@ -12,4 +13,6 @@ __all__ = [
     "Scheduler",
     "FCFSScheduler",
     "SLOScheduler",
+    "PrefixCache",
+    "MatchResult",
 ]

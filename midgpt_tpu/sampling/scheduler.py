@@ -33,6 +33,15 @@ only ever offers preemption candidates strictly younger (later
 `admit_order`) than the slot that needs pages, so the oldest running
 request always makes progress no matter what a policy returns. A policy
 returning a non-candidate is a contract violation and raises.
+
+With the cross-request prefix cache on (sampling/prefix_cache.py), the
+backpressure accounting policies see is refcount-aware: the engine's
+`_backlog_pages` charges a trie-shared page ONCE no matter how many
+queued/running requests will map it, and unreferenced trie pages are
+charged nothing because the engine reclaims them on demand BEFORE asking a
+policy for a preemption victim (`_ensure_pages`). Policies themselves are
+unchanged — eviction candidates are still slots, never trie nodes, so a
+policy can never evict a shared prefix out from under a co-reader.
 """
 
 from __future__ import annotations

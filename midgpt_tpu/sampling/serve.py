@@ -77,6 +77,23 @@ Robustness levers (each round starts with an expiry pass):
     With an empty registry (always, in production) each hook is a scan
     over an empty list. Chaos scenarios: robustness/chaos_serve.py.
 
+With `prefix_cache=True`, admissions walk a host-side radix trie over the
+pool (sampling/prefix_cache.py): fully-matched prompt pages map into the
+new slot's page table with a refcount taken and their prefill SKIPPED —
+the slot starts at `length = matched` and chunk-prefills only the
+unmatched tail (chunked prefill's traced `start` makes that free of new
+programs). Departing slots release their pages through the trie, which
+keeps complete committed pages for future matches — so a preemption victim
+re-matches its own history on readmission instead of re-prefilling from
+token 0. When the allocator runs dry, refcount-0 trie pages are reclaimed
+(LRU) BEFORE any slot is preempted; a referenced trie page is never
+reclaimed. Sharing is page-table indirection only: the compiled program
+set is identical with the cache on or off (tests/test_recompile_pins.py),
+greedy streams are bit-identical (tests/test_prefix_cache.py), and all
+three cache modes work unchanged — int8 scales are indexed by physical
+page so they are shared with their page, and speculative drafts attend
+through the same shared tables (docs/SERVING.md "Prefix cache").
+
 Streaming hooks: `on_token(uid, token, t)` fires per generated token and
 `on_finish(FinishedRequest)` on every terminal transition (finish, EOS,
 timeout, cancel) — the async server's per-token streaming rides these.
@@ -101,6 +118,7 @@ import numpy as np
 from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, PagedKVCache
 from midgpt_tpu.robustness import faults
 from midgpt_tpu.sampling.engine import sample_logits, warp_logits
+from midgpt_tpu.sampling.prefix_cache import PrefixCache
 from midgpt_tpu.sampling.scheduler import FCFSScheduler, Scheduler
 from midgpt_tpu.sampling.spec import speculative_accept
 
@@ -363,6 +381,12 @@ class _Slot:
     pages: tp.List[int] = dataclasses.field(default_factory=list)
     length: int = 0  # tokens in the paged cache
     prompt_pos: int = 0  # prompt tokens prefilled so far
+    # pages[:n_shared] are prefix-cache trie entries this slot holds one
+    # reference each on (prefix_cache engines only; 0 otherwise). The slot
+    # never writes them: match caps at len(prompt) - 1 tokens and
+    # insert_live shares only complete prompt pages, while every write
+    # after admission lands at a position >= length >= the shared span.
+    n_shared: int = 0
     generated: tp.List[int] = dataclasses.field(default_factory=list)
     token_times: tp.List[float] = dataclasses.field(default_factory=list)
     # speculative-decoding state (draft engines only): current per-slot
@@ -410,6 +434,7 @@ class ServeEngine:
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "auto",
         max_backlog_pages: tp.Optional[int] = None,
+        prefix_cache: bool = False,
         draft_params: tp.Optional[GPTParams] = None,
         draft_config: tp.Optional[GPTConfig] = None,
         draft_shares_cache: bool = False,
@@ -459,6 +484,19 @@ class ServeEngine:
         # admission is unbounded, the pre-TTL behavior.
         self.max_backlog_pages = max_backlog_pages
         self.allocator = PageAllocator(num_pages)
+        # Cross-request prefix sharing (module docstring; default OFF so a
+        # plain engine's scheduling is bit-for-bit the pre-trie behavior).
+        self.prefix_cache = PrefixCache(page_size) if prefix_cache else None
+        # prefix-cache counters (prefix_stats): matched vs structurally
+        # matchable prompt tokens per admission, COW tail re-prefills,
+        # trie pages reclaimed under allocator pressure, and total prompt
+        # tokens actually pushed through prefill chunks (the r10
+        # self-re-prefill regression pin reads this one).
+        self._prefix_matched_tokens = 0
+        self._prefix_matchable_tokens = 0
+        self.cow_pages = 0
+        self.prefix_evictions = 0
+        self.prefilled_tokens = 0
         self.cache = PagedKVCache.init(
             config, num_pages=num_pages, page_size=page_size, dtype=cache_dtype
         )
@@ -600,14 +638,31 @@ class ServeEngine:
         """Worst-case page demand committed to live (queued + running)
         requests. Uses each request's FULL footprint — prompt plus the whole
         generation budget — because that is what the pool must eventually
-        absorb if nothing times out early."""
+        absorb if nothing times out early.
+
+        With the prefix cache on the accounting is refcount-aware: a shared
+        page is charged ONCE (the trie's referenced-entry count) instead of
+        once per reader — each running slot subtracts its n_shared and each
+        queued request subtracts what it would currently match (a ref-free
+        `peek`). Refcount-0 trie pages are charged nothing: they are
+        reclaimed on demand before any preemption, so they never stand
+        between an admission and its pages. Cache off: identical to the
+        pre-trie arithmetic."""
 
         def worst(req: Request) -> int:
             return -(-(len(req.prompt) + req.max_new_tokens) // self.page_size)
 
-        queued = sum(worst(r) for r in self.queue)
-        running = sum(worst(s.request) for s in self.slots if s is not None)
-        return queued + running
+        pc = self.prefix_cache
+        queued = sum(
+            worst(r)
+            - (0 if pc is None else pc.peek(r.prompt, max_tokens=len(r.prompt) - 1))
+            for r in self.queue
+        )
+        running = sum(
+            worst(s.request) - s.n_shared for s in self.slots if s is not None
+        )
+        shared = 0 if pc is None else pc.referenced_page_count()
+        return queued + running + shared
 
     @property
     def idle(self) -> bool:
@@ -654,7 +709,7 @@ class ServeEngine:
                         status=status,
                     )
                 )
-                self.allocator.free(slot.pages)
+                self._release_slot(slot)
                 self.slots[i] = None
                 return True
         return False
@@ -699,6 +754,8 @@ class ServeEngine:
         self.rounds += 1
         if faults.should_fire("poisoned_page", step=self.rounds):
             self._poison_page()
+        if faults.should_fire("evict_shared_prefix", step=self.rounds):
+            self._evict_shared_prefix_fault()
         self._expire_round()
         self._admit()
         self._prefill_round()
@@ -741,7 +798,11 @@ class ServeEngine:
         bit-identical to an unfaulted run, the engine keeps serving, and
         the allocator stays conserved. The victim uid lands in
         `poisoned_uids` so chaos parity checks exclude exactly it
-        (tests/test_chaos_serve.py pins the isolation claim)."""
+        (tests/test_chaos_serve.py pins the isolation claim). With the
+        prefix cache on, the damaged page can be SHARED — every slot whose
+        table maps it is marked (a future trie match of the page is out of
+        scope for this fault: the poisoned_page chaos scenario runs
+        cache-off, and the trie-specific fault is evict_shared_prefix)."""
         victim = max(
             (s for s in self.slots if s is not None and s.pages),
             key=lambda s: s.admit_order,
@@ -760,7 +821,29 @@ class ServeEngine:
             k=self.cache.k.at[:, :, page].set(bad),
             v=self.cache.v.at[:, :, page].set(bad),
         )
-        self.poisoned_uids.append(victim.request.uid)
+        for s in self.slots:
+            if (
+                s is not None
+                and page in s.pages
+                and s.request.uid not in self.poisoned_uids
+            ):
+                self.poisoned_uids.append(s.request.uid)
+
+    def _evict_shared_prefix_fault(self) -> None:
+        """The `evict_shared_prefix` fault: a pressure spike (or an
+        operator flush) force-reclaims EVERY unreferenced trie page at
+        once, hot nodes included — ignoring the LRU order that normally
+        protects them. What must hold, and what the chaos gate asserts
+        (tests/test_chaos_serve.py): referenced entries survive — a shared
+        node is never evicted out from under a live reader — so every live
+        stream stays bit-identical to an unfaulted run; later requests
+        simply miss the flushed prefixes, re-prefill, and re-populate the
+        trie; and pages + refcounts stay conserved through the flush."""
+        if self.prefix_cache is None:
+            return
+        freed = self.prefix_cache.evict(0, force_all=True)
+        self.allocator.free(freed)
+        self.prefix_evictions += len(freed)
 
     def _expire_round(self) -> None:
         """Finish every deadline-expired request with a `timeout` status.
@@ -802,7 +885,7 @@ class ServeEngine:
                         status="timeout",
                     )
                 )
-                self.allocator.free(slot.pages)
+                self._release_slot(slot)
                 self.slots[i] = None
 
     def _admit(self) -> None:
@@ -818,12 +901,36 @@ class ServeEngine:
                 # A preempted request restarts its k adaptation from
                 # spec_k_max like a fresh one — the draft pool it re-prefills
                 # is fresh too, so old acceptance evidence is stale anyway.
-                self.slots[i] = _Slot(req, self._admitted, spec_k=self.spec_k_max)
+                slot = _Slot(req, self._admitted, spec_k=self.spec_k_max)
+                if self.prefix_cache is not None:
+                    # Map every fully-matched page into the slot's table and
+                    # skip its prefill: the slot starts committed at the
+                    # matched length and chunk-prefills only the tail. The
+                    # len(prompt) - 1 cap guarantees the final prompt token
+                    # is always re-prefilled, so first-token logits come
+                    # from a live chunk (never from a skipped one).
+                    mr = self.prefix_cache.match(
+                        req.prompt, max_tokens=len(req.prompt) - 1
+                    )
+                    if mr.pages:
+                        slot.pages = list(mr.pages)
+                        slot.n_shared = len(mr.pages)
+                        slot.prompt_pos = slot.length = mr.tokens
+                    ps = self.page_size
+                    self._prefix_matchable_tokens += (
+                        (len(req.prompt) - 1) // ps
+                    ) * ps
+                    self._prefix_matched_tokens += mr.tokens
+                    if mr.cow_truncated:
+                        self.cow_pages += 1
+                self.slots[i] = slot
                 self._admitted += 1
 
     def _ensure_pages(self, slot: _Slot, upto_tokens: int) -> bool:
         """Grow slot's page list to cover positions [0, upto_tokens);
-        True on success. On pool exhaustion, asks the scheduler to pick a
+        True on success. On pool exhaustion, first reclaims unreferenced
+        prefix-cache pages (LRU; a trie page nobody reads must never cost a
+        live request a preemption), then asks the scheduler to pick a
         preemption victim among the STRICTLY YOUNGER running slots (the
         engine-enforced deadlock-freedom invariant: the oldest request
         always makes progress regardless of policy) and retries; False
@@ -834,6 +941,14 @@ class ServeEngine:
             if got is not None:
                 slot.pages.extend(got)
                 return True
+            if self.prefix_cache is not None:
+                reclaimed = self.prefix_cache.evict(
+                    need - self.allocator.free_count
+                )
+                if reclaimed:
+                    self.allocator.free(reclaimed)
+                    self.prefix_evictions += len(reclaimed)
+                    continue
             candidates = [
                 s
                 for s in self.slots
@@ -856,7 +971,16 @@ class ServeEngine:
     def _evict(self, victim: _Slot) -> None:
         """Recompute-style preemption: fold generated tokens into the
         prompt, free the pages, and re-queue at the FRONT so the request
-        resumes (by re-prefilling) as soon as the pool breathes."""
+        resumes (by re-prefilling) as soon as the pool breathes.
+
+        With the prefix cache on, "free" means release THROUGH the trie:
+        the victim's complete committed pages become refcount-0 trie
+        entries, and the folded prompt's first len - 1 tokens are exactly
+        the committed content — so readmission re-matches every one of
+        those pages and re-prefills only the sub-page tail plus the pending
+        token, instead of the whole history (the r10 self-re-prefill fix,
+        pinned by tests/test_prefix_cache.py). The released pages are also
+        the freshest LRU entries, so pool pressure reclaims them last."""
         i = self.slots.index(victim)
         req = victim.request
         new_prompt = np.concatenate(
@@ -872,9 +996,27 @@ class ServeEngine:
                 req.deadline,  # the clock keeps running across preemptions
             ),
         )
-        self.allocator.free(victim.pages)
+        self._release_slot(victim)
         self.slots[i] = None
         self.preemptions += 1
+
+    def _release_slot(self, slot: _Slot) -> None:
+        """The ONE funnel a departing slot's pages go through (finish,
+        cancel, timeout, preemption). Cache off: straight back to the
+        allocator. Cache on: the trie drops the slot's shared-page refs,
+        absorbs its complete committed pages for future matches, and only
+        the remainder (partial tails, content-duplicates) hits the free
+        list — page conservation becomes free_count + trie pages ==
+        num_pages - 1 (tests/test_prefix_cache.py, chaos_serve.py)."""
+        if self.prefix_cache is None:
+            self.allocator.free(slot.pages)
+            return
+        committed = np.concatenate(
+            [slot.request.prompt, np.asarray(slot.generated, np.int32)]
+        )[: slot.length]
+        self.allocator.free(
+            self.prefix_cache.release(committed, slot.pages, slot.n_shared)
+        )
 
     def _page_table(self, n_pages: tp.Optional[int] = None) -> np.ndarray:
         table = np.zeros((self.max_slots, n_pages or self.max_pages_per_slot), np.int32)
@@ -950,7 +1092,16 @@ class ServeEngine:
             )
         slot.prompt_pos += n_valid
         slot.length = slot.prompt_pos
+        self.prefilled_tokens += n_valid
         if not slot.prefilling:
+            if self.prefix_cache is not None:
+                # The prompt's complete pages are immutable from here on
+                # (every later write lands at a position >= len(prompt)):
+                # share them so concurrent and future requests — including
+                # this one after a preemption — skip their prefill.
+                slot.n_shared = self.prefix_cache.insert_live(
+                    prompt, slot.pages, slot.n_shared
+                )
             # Prompt complete: sample the first generated token from the
             # last valid prompt position's logits (host-side; greedy argmax
             # matches engine.generate's sample_logits(temperature=0) exactly).
@@ -1185,7 +1336,13 @@ class ServeEngine:
             # them — scales are indexed by physical page, so the same free
             # covers both, and both are rewritten before their page is next
             # read (write-before-read, GPT.verify_step_paged docstring).
-            keep = -(-slot.length // self.page_size)
+            # Shared prefix pages sit below length (length >= matched + 1
+            # from admission on), so keep > n_shared already; the max() is
+            # a defensive floor — rollback must never hand a trie-owned
+            # page to the allocator.
+            keep = max(
+                -(-slot.length // self.page_size), slot.n_shared
+            )
             if len(slot.pages) > keep:
                 tail = slot.pages[keep:]
                 del slot.pages[keep:]
@@ -1203,6 +1360,32 @@ class ServeEngine:
             "accept_rate": self._spec_accepted / drafted,
             "tokens_per_verify": (self._spec_accepted + self._spec_verifies)
             / verifies,
+        }
+
+    def prefix_stats(self) -> tp.Dict[str, tp.Any]:
+        """Prefix-cache counters since construction (reported by
+        tools/bench_serve.py's serve_prefix profile and tools/loadgen.py).
+        `hit_rate` is matched / MATCHABLE prompt tokens, where matchable is
+        the structural ceiling per admission — ((len(prompt) - 1) //
+        page_size) * page_size, the most any match could hand out under the
+        reserve-the-last-token rule — so a perfect template workload can
+        actually reach 1.0. `prefilled_tokens` counts what went through
+        prefill chunks; with sharing it is the complement of the hits (the
+        r10 regression pin, tests/test_prefix_cache.py)."""
+        pc = self.prefix_cache
+        matchable = self._prefix_matchable_tokens
+        return {
+            "enabled": pc is not None,
+            "matched_tokens": self._prefix_matched_tokens,
+            "matchable_tokens": matchable,
+            "hit_rate": (
+                self._prefix_matched_tokens / matchable if matchable else 0.0
+            ),
+            "cow_pages": self.cow_pages,
+            "prefilled_tokens": self.prefilled_tokens,
+            "trie_pages": 0 if pc is None else pc.page_count(),
+            "trie_referenced": 0 if pc is None else pc.referenced_page_count(),
+            "reclaimed_pages": self.prefix_evictions,
         }
 
     def _finish(self, fr: FinishedRequest) -> None:
@@ -1232,7 +1415,7 @@ class ServeEngine:
                     token_times=slot.token_times,
                 )
             )
-            self.allocator.free(slot.pages)
+            self._release_slot(slot)
             self.slots[slot_i] = None
             return True
         return False

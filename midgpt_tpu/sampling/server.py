@@ -267,6 +267,24 @@ class AsyncServeServer:
         st = self._streams.get(uid)
         return None if st is None else st.finished
 
+    def stats(self) -> tp.Dict[str, tp.Any]:
+        """Engine observability snapshot for metrics scrapes (used by
+        tools/loadgen.py). Counters are plain ints mutated only inside
+        `engine.step` on the driver's worker thread, so a read from the
+        event loop is at worst one round stale, never torn."""
+        eng = self.engine
+        return {
+            "rounds": eng.rounds,
+            "shed": eng.shed,
+            "timeouts": eng.timeouts,
+            "cancelled": eng.cancelled,
+            "preemptions": eng.preemptions,
+            "decode_kills": eng.decode_kills,
+            "prefilled_tokens": eng.prefilled_tokens,
+            "free_pages": eng.allocator.free_count,
+            "prefix": eng.prefix_stats(),
+        }
+
     async def drain(self) -> None:
         """Stop admission and wait for every in-flight request to finish.
         `run()` returns once the engine is idle. Idempotent."""

@@ -31,6 +31,19 @@ tail page orphans its f32 scale entries together with its int8 columns
 token-identical to plain paged decode on the SAME quantized pool — the
 draft's prefix-layer writes and the verify rewrite quantize identical
 values (pinned by tests/test_quant_cache.py).
+
+The cross-request prefix cache (sampling/prefix_cache.py) composes with
+both draft modes. Self-draft rides shared pages for free: the draft IS the
+target's first layers on the target's pool, so a trie-matched prefix skips
+draft prefill too. A separate-weights draft keeps its own pool mirrored
+page-for-page, so sharing carries over structurally; the one wrinkle is
+that a trie page covering GENERATED tokens holds draft-pool K/V from
+whichever proposal stream produced it, which may differ from what a fresh
+draft prefill would write. That staleness can only lower the draft's
+acceptance rate for the reader — verification re-scores every proposal
+with the target, so output exactness is untouched (the serving greedy
+parity pins hold with the cache on in every spec mode,
+tests/test_prefix_cache.py).
 """
 
 from __future__ import annotations

@@ -13,6 +13,7 @@ import sys
 from midgpt_tpu.analysis.bench_contract import (
     check_bench_stdout,
     check_serve_bench,
+    check_serve_prefix_bench,
     check_serve_slo_bench,
     check_train_bench,
     parse_single_json_line,
@@ -93,6 +94,70 @@ def test_bench_serve_spec_emits_conformant_json_line(capsys):
     assert rec["compile_counts"]["spec_verify"] >= 1
     # prefix self-draft: speculation must not cost extra cache HBM
     assert rec["hbm_draft_cache_bytes"] == 0
+
+
+def test_bench_serve_prefix_emits_conformant_json_line(capsys):
+    """--shared-prefix-frac mode: the serve_prefix profile (prefix cache
+    on vs off over a template-heavy workload) must hold the one-JSON-line
+    contract, report exact greedy parity, and never prefill MORE with the
+    cache on. Tiny shapes — structure check, not a perf claim."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--shared-prefix-frac", "0.8",
+            "--n-requests", "6",
+            "--template-tokens", "24",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_prefix")
+    assert not problems, problems
+    assert rec["greedy_match_frac"] == 1.0
+    assert 0.0 < rec["prefix_hit_rate"] <= 1.0
+    assert rec["prefix_prefill_tokens"] <= rec["baseline_prefill_tokens"]
+    # checker drift behavior on the real record: inexact parity and a
+    # prefill regression are contract violations, not numbers
+    assert any(
+        "greedy_match_frac" in p
+        for p in check_serve_prefix_bench(dict(rec, greedy_match_frac=0.99))
+    )
+    assert any(
+        "prefill" in p
+        for p in check_serve_prefix_bench(
+            dict(rec, prefix_prefill_tokens=rec["baseline_prefill_tokens"] + 1)
+        )
+    )
+
+
+def test_loadgen_prefix_cache_emits_hit_rate(capsys):
+    """tools/loadgen.py --prefix-cache: the serve_slo line still conforms
+    and carries per-point + headline prefix_hit_rate fields."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "loadgen.py"),
+        [
+            "loadgen.py",
+            "--rates", "30,90",
+            "--n-requests", "4",
+            "--template-frac", "0.75",
+            "--prefix-cache",
+            "--seed", "0",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_slo")
+    assert not problems, problems
+    assert rec["prefix_cache"] is True
+    for p in rec["points"]:
+        assert 0.0 <= p["prefix_hit_rate"] <= 1.0
+    assert 0.0 <= rec["prefix_hit_rate"] <= 1.0
 
 
 def test_loadgen_emits_conformant_serve_slo_line(capsys):

@@ -70,6 +70,23 @@ def test_chaos_submit_storm_sheds_and_survivors_finish():
     assert s["pages_conserved"]
 
 
+def test_chaos_evict_shared_prefix_flush_never_corrupts_readers():
+    """A forced flush of the prefix trie (pressure spike, LRU ignored)
+    reclaims every unreferenced shared page mid-trace; referenced entries
+    survive by construction, so every live stream stays bit-identical,
+    later requests just re-prefill, and pages + refcounts are conserved
+    through the flush. Both passes run cache-ON over template-shared
+    traffic, so the reference pass doubles as a cache parity check."""
+    s = run_serving_chaos("evict_shared_prefix@7", seed=0, n_requests=6)
+    assert s["faults_fired"] == {"evict_shared_prefix": 1}
+    assert s["prefix_cache"] is True
+    assert s["prefix_reclaimed"] > 0, "the flush must reclaim trie pages"
+    assert s["statuses"] == {"ok": 6}
+    assert s["parity_ok"] == s["parity_checked"] == 6
+    assert 0.0 < s["prefix_hit_rate"] < 1.0  # the flush cost later matches
+    assert s["pages_conserved"]
+
+
 def test_chaos_run_serve_cli_emits_one_json_line(capsys):
     """`chaos_run.py --serve` holds the one-JSON-line driver contract and
     carries the chaos verdict fields."""

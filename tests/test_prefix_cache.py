@@ -1,0 +1,329 @@
+"""Cross-request prefix cache (sampling/prefix_cache.py): trie unit
+behavior, and the serving-level acceptance pins — greedy token parity with
+the cache ON in every cache mode (f32, int8, speculative), copy-on-write
+isolation for duplicate prompts, page + refcount conservation across the
+full slot lifecycle (finish/cancel/TTL/preemption), and the r10
+self-re-prefill regression: a preempted request resumes by re-matching its
+own donated pages instead of re-prefilling its whole folded prompt."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.sampling.engine import generate
+from midgpt_tpu.sampling.prefix_cache import PrefixCache
+from midgpt_tpu.sampling.serve import ServeEngine
+
+CFG = GPTConfig(block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32)
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT.init(CFG, jax.random.PRNGKey(0))
+
+
+# ----------------------------------------------------------------------
+# trie unit behavior (pure host code, no model)
+# ----------------------------------------------------------------------
+
+
+def test_trie_insert_match_release_roundtrip():
+    pc = PrefixCache(4)
+    a = list(range(9))  # 2 full pages + 1-token tail
+    assert pc.insert_live(a, [5, 6, 7], 0) == 2
+    assert pc.page_count() == 2 and pc.referenced_page_count() == 2
+
+    mr = pc.match(a, max_tokens=len(a) - 1)
+    assert mr.pages == [5, 6] and mr.tokens == 8
+    # two readers now: the inserter and the matcher
+    assert pc.stats()["refs"] == 4
+
+    # matcher departs: sheds its refs, its private tail page is freed
+    assert pc.release(a, [5, 6, 9], 2) == [9]
+    # inserter departs: trie keeps the content at refcount 0
+    assert pc.release(a, [5, 6, 7], 2) == [7]
+    assert pc.referenced_page_count() == 0 and pc.page_count() == 2
+    # and an identical future request still matches it
+    assert pc.peek(a) == 2
+
+
+def test_trie_split_on_divergence():
+    pc = PrefixCache(4)
+    a = [1] * 4 + [2] * 4
+    b = [1] * 4 + [3] * 4
+    assert pc.insert_live(a, [1, 2], 0) == 2
+    mr = pc.match(b)  # shares the first page only
+    assert mr.pages == [1] and mr.tokens == 4
+    # b's second page diverges inside the compressed chain -> split
+    assert pc.insert_live(b, [1, 4], 1) == 2
+    assert pc.page_count() == 3
+    assert pc.match(a).pages == [1, 2]
+    assert pc.match(b).pages == [1, 4]
+
+
+def test_trie_match_cap_reserves_last_token_and_flags_cow():
+    pc = PrefixCache(4)
+    a = list(range(8))
+    pc.insert_live(a, [1, 2], 0)
+    # the engine's cap: a prompt of exactly 8 tokens may match only 1 page
+    # (the final token must re-prefill), and because the trie's second page
+    # carries the rest of the prompt, the truncation is a COW event
+    mr = pc.match(a, max_tokens=len(a) - 1)
+    assert mr.pages == [1] and mr.tokens == 4 and mr.cow_truncated
+    # a prompt diverging right after the match is a plain miss, not COW
+    mr2 = pc.match([0, 1, 2, 3, 90, 91], max_tokens=5)
+    assert mr2.pages == [1] and not mr2.cow_truncated
+
+
+def test_trie_peek_is_side_effect_free():
+    pc = PrefixCache(4)
+    a = list(range(8))
+    pc.insert_live(a, [1, 2], 0)
+    before = pc.stats()
+    assert pc.peek(a, max_tokens=len(a) - 1) == 1
+    assert pc.peek(a) == 2
+    assert pc.stats() == before
+
+
+def test_trie_release_frees_content_duplicates():
+    """Two slots prefilled the same content concurrently (neither could
+    match the other mid-flight): the second insert stops sharing at the
+    duplicate, and its release frees the private copies instead of
+    double-registering the content."""
+    pc = PrefixCache(4)
+    a = list(range(8))
+    assert pc.insert_live(a, [1, 2], 0) == 2
+    assert pc.insert_live(a, [3, 4], 0) == 0  # duplicate raced in
+    assert sorted(pc.release(a, [3, 4], 0)) == [3, 4]
+    assert pc.page_count() == 2 and pc.pages_held() == {1, 2}
+
+
+def test_trie_evict_lru_deepest_first_and_never_referenced():
+    pc = PrefixCache(4)
+    a = list(range(12))  # one chain of 3 entries
+    pc.insert_live(a, [1, 2, 3], 0)
+    assert pc.evict(3) == []  # all referenced: nothing reclaimable
+    pc.release(a, [1, 2, 3], 3)
+    # deepest entry first: a page never leaves while pages extending it stay
+    assert pc.evict(1) == [3]
+    b = [7] * 8
+    pc.insert_live(b, [4, 5], 0)
+    pc.release(b, [4, 5], 2)
+    pc.match(a[:8])  # touch the a-branch: b's branch is now LRU-oldest
+    pc.release(a[:8], [1, 2], 2)
+    assert pc.evict(2) == [5, 4]
+    assert pc.evict(0, force_all=True) == [2, 1]
+    assert pc.page_count() == 0
+
+
+# ----------------------------------------------------------------------
+# serving-level pins
+# ----------------------------------------------------------------------
+
+
+def _engine(params, prefix, num_pages=29, cache_dtype=jnp.float32, **kw):
+    # NOT num_pages=25: the pool size is a program-key dim and the recompile
+    # pins (tests/test_recompile_pins.py) count the 25-page f32 program set
+    # from a pristine baseline (same rule as chaos_serve.py).
+    return ServeEngine(
+        CFG, params, max_slots=3, page_size=PS, num_pages=num_pages,
+        prefill_chunk=16, decode_chunk=4, temperature=0.0,
+        cache_dtype=cache_dtype, prefix_cache=prefix, **kw,
+    )
+
+
+def _template_trace(seed=0, n_templated=6, n_unique=3, t_len=24):
+    """Template-heavy traffic: two shared t_len-token heads with short
+    unique tails, plus a few fully unique prompts."""
+    rng = np.random.default_rng(seed)
+    templates = [
+        rng.integers(0, CFG.vocab_size, t_len).astype(np.int32)
+        for _ in range(2)
+    ]
+    trace = []
+    for i in range(n_templated):
+        tail = rng.integers(
+            0, CFG.vocab_size, int(rng.integers(2, 7))
+        ).astype(np.int32)
+        trace.append(
+            (np.concatenate([templates[i % 2], tail]), int(rng.integers(6, 12)))
+        )
+    for _ in range(n_unique):
+        trace.append((
+            rng.integers(
+                0, CFG.vocab_size, int(rng.integers(4, 12))
+            ).astype(np.int32),
+            int(rng.integers(6, 12)),
+        ))
+    return trace
+
+
+def _assert_conserved(eng):
+    """Drained-engine conservation with the cache on: every page is either
+    free or a trie entry, and no trie refcount outlived its reader."""
+    assert eng.idle
+    assert (
+        eng.allocator.free_count + eng.prefix_cache.page_count()
+        == eng.allocator.num_pages - 1
+    )
+    assert eng.prefix_cache.referenced_page_count() == 0
+
+
+def _run_pair(params, trace, **kw):
+    """The same trace through a cache-off and a cache-on engine."""
+    outs = []
+    for prefix in (False, True):
+        eng = _engine(params, prefix, **kw)
+        uids = [eng.submit(p, m) for p, m in trace]
+        done = eng.run()
+        assert set(done) == set(uids)
+        outs.append((eng, [np.asarray(done[u].tokens) for u in uids]))
+    return outs
+
+
+def test_prefix_greedy_parity_f32(params):
+    """The acceptance pin: enabling the cache on a template-heavy trace is
+    token-invisible — every stream is bit-identical to the cache-off run
+    AND to the fixed-batch reference — while the trie demonstrably absorbs
+    prefill work."""
+    trace = _template_trace()
+    (eng_off, toks_off), (eng_on, toks_on) = _run_pair(params, trace)
+    for i, ((p, m), a, b) in enumerate(zip(trace, toks_off, toks_on)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+        ref = generate(CFG, params, jnp.asarray(p)[None], m, temperature=0.0)
+        np.testing.assert_array_equal(b, np.asarray(ref[0]), err_msg=f"request {i}")
+    st = eng_on.prefix_stats()
+    assert st["hit_rate"] > 0.0, "template traffic must hit the trie"
+    assert eng_on.prefilled_tokens < eng_off.prefilled_tokens
+    assert eng_on.prefilled_tokens + st["matched_tokens"] >= eng_off.prefilled_tokens
+    _assert_conserved(eng_on)
+
+
+def test_prefix_cow_duplicate_prompt_isolated(params):
+    """An exact-duplicate prompt (a retried query) matches up to the
+    reserve-the-last-token cap and re-prefills the remainder into a PRIVATE
+    page even though a trie page carries the same leading tokens — the
+    copy-on-write truncation. Both runs must produce identical tokens, and
+    the second must be flagged as a COW admission."""
+    rng = np.random.default_rng(11)
+    p = rng.integers(0, CFG.vocab_size, 21).astype(np.int32)  # mid-page end
+    eng = _engine(params, True)
+    u1 = eng.submit(p, 10)
+    eng.run()
+    assert eng.cow_pages == 0
+    u2 = eng.submit(p, 10)
+    eng.run()
+    assert eng.cow_pages == 1, "duplicate admission must be a COW truncation"
+    assert eng.prefix_cache.match(p, max_tokens=len(p) - 1).tokens == 16
+    np.testing.assert_array_equal(
+        eng.finished[u1].tokens, eng.finished[u2].tokens
+    )
+    ref = generate(CFG, params, jnp.asarray(p)[None], 10, temperature=0.0)
+    np.testing.assert_array_equal(eng.finished[u2].tokens, np.asarray(ref[0]))
+
+
+def test_prefix_parity_int8_shares_scales(params):
+    """int8 pool mode: the per-page absmax scales are indexed by physical
+    page alongside the int8 columns, so a shared page shares its scales by
+    construction — cache-on must stay bit-identical to cache-off at the
+    SAME dtype (quantization is deterministic, so this is exact equality,
+    not a tolerance)."""
+    trace = _template_trace(seed=2)
+    (eng_off, toks_off), (eng_on, toks_on) = _run_pair(
+        params, trace, cache_dtype="int8"
+    )
+    for i, (a, b) in enumerate(zip(toks_off, toks_on)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+    assert eng_on.prefix_stats()["hit_rate"] > 0.0
+    _assert_conserved(eng_on)
+
+
+def test_prefix_parity_spec_self_draft(params):
+    """Speculative self-draft mode: the draft IS the target's first layers
+    on the target's pool, so trie-shared pages serve draft and verify alike
+    and spec rollback never strips a shared page (keep >= n_shared). Greedy
+    spec+cache must equal greedy cache-off spec AND the plain reference."""
+    from midgpt_tpu.sampling.spec import self_draft
+
+    dcfg, dparams = self_draft(CFG, params, 1)
+    trace = _template_trace(seed=3, n_templated=4, n_unique=2)
+    spec_kw = dict(
+        draft_params=dparams, draft_config=dcfg, draft_shares_cache=True,
+        spec_k_max=4, spec_k_min=4, spec_adapt=False,
+    )
+    (eng_off, toks_off), (eng_on, toks_on) = _run_pair(
+        params, trace, **spec_kw
+    )
+    for i, ((p, m), a, b) in enumerate(zip(trace, toks_off, toks_on)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+        ref = generate(CFG, params, jnp.asarray(p)[None], m, temperature=0.0)
+        np.testing.assert_array_equal(b, np.asarray(ref[0]), err_msg=f"request {i}")
+    assert eng_on.prefix_stats()["hit_rate"] > 0.0
+    _assert_conserved(eng_on)
+
+
+def test_r10_preemption_resume_skips_self_reprefill(params):
+    """The r10 regression pin. UNIQUE prompts in a pool too small for the
+    working set: sharing between requests is impossible, so every trie hit
+    is a preempted request re-matching its OWN donated pages. Cache off,
+    each preemption re-prefills the whole folded prompt; cache on, resume
+    costs at most the sub-page tail (< page_size tokens) per preemption —
+    prefilled_tokens collapses to ~first-admission cost."""
+    rng = np.random.default_rng(5)
+    trace = []
+    for i in range(3):
+        p = rng.integers(0, CFG.vocab_size, 20).astype(np.int32)
+        p[0] = i  # force distinct first pages: no cross-request sharing
+        trace.append((p, 20))
+    sum_len = sum(len(p) for p, _ in trace)
+    (eng_off, toks_off), (eng_on, toks_on) = _run_pair(
+        params, trace, num_pages=14
+    )
+    assert eng_off.preemptions >= 1, "the pool must actually force preemption"
+    for i, ((p, m), a, b) in enumerate(zip(trace, toks_off, toks_on)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+    # cache off: every preemption re-prefilled a whole folded prompt
+    assert eng_off.prefilled_tokens >= sum_len + eng_off.preemptions * min(
+        len(p) for p, _ in trace
+    )
+    # cache on: resume re-matches the donated pages, so each preemption
+    # costs at most the sub-page tail (plus the pending token a fold
+    # appends) — UNLESS pool pressure trie-reclaimed a donated page first,
+    # which costs at most page_size more per reclaimed page (the
+    # prefix_evictions term; at this pool size it stays small)
+    assert eng_on.prefilled_tokens <= (
+        sum_len
+        + eng_on.preemptions * (PS + 1)
+        + eng_on.prefix_evictions * PS
+    )
+    assert eng_on.prefilled_tokens < eng_off.prefilled_tokens
+    _assert_conserved(eng_on)
+
+
+def test_prefix_conservation_across_cancel_and_ttl(params):
+    """Every departure path goes through the trie release funnel: finish,
+    client cancel, and TTL expiry must all conserve pages and drop every
+    refcount — with the trie still holding re-matchable content after."""
+    t = [0.0]
+    eng = _engine(params, True, clock=lambda: t[0])
+    trace = _template_trace(seed=7, n_templated=4, n_unique=1)
+    uids = [
+        eng.submit(p, m, ttl_s=(0.5 if i == 2 else None))
+        for i, (p, m) in enumerate(trace)
+    ]
+    for _ in range(2):
+        eng.step()
+    assert eng.cancel(uids[1])
+    t[0] = 1.0  # the TTL'd request expires on the next round
+    eng.run()
+    statuses = {u: eng.finished[u].status for u in uids}
+    assert statuses[uids[1]] == "cancelled"
+    assert statuses[uids[2]] == "timeout"
+    assert sum(1 for s in statuses.values() if s == "ok") == len(uids) - 2
+    _assert_conserved(eng)
+    assert eng.prefix_cache.page_count() > 0, (
+        "departing slots must donate their committed pages"
+    )

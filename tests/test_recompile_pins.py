@@ -200,6 +200,46 @@ def test_int8_cache_is_a_program_key_but_compiles_once_per_bucket(params):
     assert cc.count == 0, f"int8 request-mix change recompiled {cc.count}"
 
 
+def test_prefix_cache_compiles_zero_new_programs(params):
+    """Tentpole pin (prefix-cache PR): cross-request sharing is page-table
+    indirection over existing jit inputs, so a cache-ON engine serving
+    hit, miss, and COW-duplicate admissions compiles NOTHING a cache-off
+    engine at the same geometry didn't already compile. Warm-then-count on
+    a non-25-page pool so this pin composes with the pristine-baseline
+    pins above. Mix design: all prompts 28 tokens / budget 9 so both modes
+    touch the same pow2 page buckets (a trie-matched admission can only
+    SKIP early prefill buckets, never reach a new one)."""
+
+    def mix(prefix, seed):
+        eng = ServeEngine(
+            CFG, params, max_slots=3, page_size=8, num_pages=31,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32, prefix_cache=prefix,
+        )
+        rng = np.random.default_rng(seed)
+        head = rng.integers(0, CFG.vocab_size, 24).astype(np.int32)
+        tails = [rng.integers(0, CFG.vocab_size, 4).astype(np.int32)
+                 for _ in range(2)]
+        prompts = [np.concatenate([head, t]) for t in tails]
+        prompts.append(rng.integers(0, CFG.vocab_size, 28).astype(np.int32))
+        uids = [eng.submit(p, 9) for p in prompts]
+        assert set(eng.run()) == set(uids)
+        # second wave against a warm trie: template hit, exact-duplicate
+        # COW truncation, and a plain unique miss
+        uids += [eng.submit(p, 9) for p in (prompts[0], prompts[2])]
+        assert set(eng.run()) == set(uids)
+        if prefix:
+            assert eng.prefix_stats()["hit_rate"] > 0.0
+            assert eng.cow_pages >= 1, "the duplicate must take the COW path"
+        return eng
+
+    mix(False, seed=0)  # warm every program this geometry/mix reaches
+    with CompileCounter() as cc:
+        mix(True, seed=0)  # same trace, cache on: hits + COW + misses
+        mix(True, seed=1)  # fresh content, cold trie again
+    assert cc.count == 0, f"prefix cache compiled {cc.count} new program(s)"
+
+
 def test_train_step_compiles_exactly_once():
     cfg = ExperimentConfig(
         rundir="",

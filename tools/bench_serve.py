@@ -211,6 +211,142 @@ def _spec_bench(args, cfg, params, cache_dtype, trace, total_new) -> int:
     return 0
 
 
+def _prefix_bench(args, cfg, params, cache_dtype) -> int:
+    """--shared-prefix-frac mode: template-heavy workload (N shared system
+    prompts x unique tails, plus exact-duplicate resubmissions that
+    exercise the copy-on-write truncation path) through the SAME engine
+    twice — prefix cache off, then on, at the same page budget. Emits the
+    'serve_prefix' JSON profile (analysis/bench_contract.py): the headline
+    numbers are prefix_hit_rate, the TTFT collapse (template prefill
+    skipped), and greedy_match_frac, which must be EXACTLY 1.0 — shared
+    pages hold bit-identical K/V to privately prefilled ones, so sharing
+    is invisible to the streams (tests/test_prefix_cache.py pins this per
+    cache mode)."""
+    import jax
+    import numpy as np
+
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    rng = np.random.default_rng(args.seed)
+    V = cfg.vocab_size
+    n_templates = args.prefix_templates
+    t_len = args.template_tokens or 5 * args.page_size
+    if t_len + 16 + 12 > cfg.block_size:
+        raise SystemExit(
+            f"--template-tokens {t_len} leaves no room for tails in "
+            f"block_size {cfg.block_size}"
+        )
+    templates = [
+        rng.integers(0, V, t_len, dtype=np.int64) for _ in range(n_templates)
+    ]
+    trace = []
+    for i in range(args.n_requests):
+        m = int(rng.integers(8, 13))
+        if rng.random() < args.shared_prefix_frac:
+            if trace and rng.random() < 0.25:
+                # exact duplicate of an earlier templated prompt (a retried
+                # query): its first post-template page prefix-matches a trie
+                # page, so the capped match reports a COW truncation
+                prompt = trace[rng.integers(0, len(trace))][0]
+                while len(prompt) <= t_len:  # ensure it IS a templated one
+                    prompt = trace[rng.integers(0, len(trace))][0]
+            else:
+                tail = rng.integers(
+                    0, V, int(rng.integers(3, 9)), dtype=np.int64
+                )
+                prompt = np.concatenate([templates[i % n_templates], tail])
+        else:
+            prompt = rng.integers(
+                0, V, int(rng.integers(4, 11)), dtype=np.int64
+            )
+        trace.append((prompt, m))
+    total_new = sum(m for _, m in trace)
+    pool_kw = (
+        {"pool_hbm_bytes": args.pool_hbm_bytes} if args.pool_hbm_bytes else {}
+    )
+
+    def run(prefix_on):
+        eng = ServeEngine(
+            cfg,
+            params,
+            max_slots=args.max_slots,
+            page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk,
+            decode_chunk=args.decode_chunk,
+            temperature=0.0,
+            cache_dtype=cache_dtype,
+            prefix_cache=prefix_on,
+            **pool_kw,
+        )
+        uids = [(eng.submit(p, m), len(p)) for p, m in trace]
+        t0 = time.perf_counter()
+        done = eng.run()
+        return eng, done, time.perf_counter() - t0, t0, uids
+
+    run(False)  # warm every jit shape (a fresh engine per run: cold trie)
+    eng_off, done_off, dt_off, t0_off, uids = run(False)
+    eng_on, done_on, dt_on, t0_on, _ = run(True)
+    _, ttft_off, _ = _latency_stats(done_off, t0_off)
+    _, ttft_on, _ = _latency_stats(done_on, t0_on)
+    st = eng_on.prefix_stats()
+
+    print(
+        json.dumps(
+            {
+                "bench": "serve_prefix",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "max_slots": args.max_slots,
+                "page_size": args.page_size,
+                "kv_dtype": args.kv_dtype,
+                "num_pages": eng_on.allocator.num_pages,
+                "pool_hbm_bytes": args.pool_hbm_bytes or None,
+                "shared_prefix_frac": args.shared_prefix_frac,
+                "n_templates": n_templates,
+                "template_tokens": t_len,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "baseline_tok_s": round(total_new / dt_off, 2),
+                "prefix_tok_s": round(total_new / dt_on, 2),
+                "speedup_prefix": round(dt_off / dt_on, 3),
+                "baseline_ttft_ms_p50": round(
+                    float(np.percentile(ttft_off, 50)) * 1e3, 3
+                ),
+                "baseline_ttft_ms_p95": round(
+                    float(np.percentile(ttft_off, 95)) * 1e3, 3
+                ),
+                "prefix_ttft_ms_p50": round(
+                    float(np.percentile(ttft_on, 50)) * 1e3, 3
+                ),
+                "prefix_ttft_ms_p95": round(
+                    float(np.percentile(ttft_on, 95)) * 1e3, 3
+                ),
+                "prefix_hit_rate": round(st["hit_rate"], 4),
+                "cow_pages": st["cow_pages"],
+                "baseline_prefill_tokens": eng_off.prefilled_tokens,
+                "prefix_prefill_tokens": eng_on.prefilled_tokens,
+                "baseline_preemptions": eng_off.preemptions,
+                "prefix_preemptions": eng_on.preemptions,
+                "trie_pages": st["trie_pages"],
+                "reclaimed_pages": st["reclaimed_pages"],
+                # exact by construction: shared pages ARE the pages a
+                # private prefill of the same tokens would have written
+                "greedy_match_frac": round(
+                    _greedy_match_frac(done_off, done_on, uids), 4
+                ),
+                "cache_hbm_bytes": int(eng_on.cache_hbm_bytes()),
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-requests", type=int, default=12)
@@ -257,6 +393,18 @@ def main() -> int:
                     help="spec_k_max for the speculative engine (pow2)")
     ap.add_argument("--train-steps", type=int, default=60,
                     help="--spec: quick-train steps before benchmarking")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    help="> 0 selects the prefix-cache bench: this fraction "
+                    "of requests share one of --prefix-templates system "
+                    "prompts (the rest are unique short prompts), and the "
+                    "trace runs cache-off then cache-on at the same page "
+                    "budget ('serve_prefix' JSON profile). 0.8 with "
+                    "--n-requests 24 is the acceptance workload "
+                    "(docs/SERVING.md 'Prefix cache')")
+    ap.add_argument("--prefix-templates", type=int, default=2,
+                    help="distinct shared system prompts in the workload")
+    ap.add_argument("--template-tokens", type=int, default=0,
+                    help="template length (0 = 5 * page_size)")
     args = ap.parse_args()
     if args.n_layer is None:
         args.n_layer = 6 if args.spec else 4
@@ -294,13 +442,19 @@ def main() -> int:
     baseline_dtype = jnp.bfloat16 if on_tpu else jnp.float32
     quantized = args.kv_dtype == "int8"
     train_loss = None
-    if quantized and not args.spec:
+    if quantized and not args.spec and not args.shared_prefix_frac:
+        # (the prefix bench skips the fit: its greedy_match_frac compares
+        # cache-on vs cache-off at the SAME dtype, which is exact bitwise
+        # — no numeric perturbation for training to make meaningful)
         # An untrained model's greedy argmax is fragile under ANY cache
         # perturbation (near-uniform logits), so the int8-vs-bf16 accuracy
         # number is only meaningful on a model that has learned something
         # — same reasoning as the --spec bench's quick fit.
         params, train_loss = _quick_train(cfg, params, args.train_steps, args.seed)
     cache_dtype = "int8" if quantized else baseline_dtype
+
+    if args.shared_prefix_frac:
+        return _prefix_bench(args, cfg, params, cache_dtype)
 
     # Mixed-length trace: short chat-y prompts to near-context documents.
     rng = np.random.default_rng(args.seed)

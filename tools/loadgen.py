@@ -6,9 +6,13 @@ and time-per-output-token percentiles under an offered load, and what
 fraction of traffic had to be shed to hold them (the error budget). This
 harness generates a seeded arrival process (Poisson or bursty), a
 prompt/output-length mixture (short chat-y requests vs long-document
-requests), drives the asyncio front door (sampling/server.py) over a
-fresh `ServeEngine` at each offered-load point, and emits ONE JSON line
-(driver contract, `serve_slo` profile in analysis/bench_contract.py):
+requests, optionally a `--template-frac` share of template-headed
+system-prompt traffic), drives the asyncio front door
+(sampling/server.py) over a fresh `ServeEngine` at each offered-load
+point, and emits ONE JSON line (driver contract, `serve_slo` profile in
+analysis/bench_contract.py). With `--prefix-cache` the engines run with
+the cross-request prefix cache on and per-point/headline
+`prefix_hit_rate` fields report how much prefill the trie absorbed:
 
     python tools/loadgen.py --process poisson --rates 20,60 \
         [--scheduler slo] [--ttl-s 2.0] [--slo-ttft-ms 500 --slo-tpot-ms 50] \
@@ -72,11 +76,30 @@ def _arrivals(process: str, rate: float, n: int, rng, burst_size: int):
     return out
 
 
-def _mixture(rng, n: int, block_size: int, vocab: int, long_frac: float):
+def _mixture(
+    rng, n: int, block_size: int, vocab: int, long_frac: float,
+    templates: tp.Sequence[np.ndarray] = (), template_frac: float = 0.0,
+):
     """Prompt/output-length mixture: mostly short interactive requests, a
-    `long_frac` tail of long-document prompts with bigger budgets."""
+    `long_frac` tail of long-document prompts with bigger budgets. With
+    `template_frac` > 0, that fraction of requests instead share one of
+    `templates` as a common prompt head (system-prompt traffic) with a
+    short unique tail — the workload the cross-request prefix cache
+    (sampling/prefix_cache.py) exists for. Templates are built once per
+    SEED, not per point, so every offered-load point measures the same
+    shared heads — points stay comparable even though each point's fresh
+    engine starts with a cold trie."""
     reqs = []
     for _ in range(n):
+        if templates and rng.random() < template_frac:
+            head = templates[int(rng.integers(0, len(templates)))]
+            tail = rng.integers(
+                0, vocab, int(rng.integers(2, 8)), dtype=np.int64
+            )
+            prompt = np.concatenate([head, tail])
+            m = min(int(rng.integers(6, 14)), block_size - len(prompt) - 1)
+            reqs.append((prompt, m))
+            continue
         if rng.random() < long_frac:
             t0 = int(rng.integers(block_size // 4, block_size // 2))
             m = int(rng.integers(12, 24))
@@ -197,6 +220,16 @@ def main() -> int:
                     help="--process bursty: simultaneous arrivals per burst")
     ap.add_argument("--long-frac", type=float, default=0.25,
                     help="fraction of long-document requests in the mixture")
+    ap.add_argument("--template-frac", type=float, default=0.0,
+                    help="fraction of requests sharing a template prompt "
+                    "head (system-prompt traffic); pair with "
+                    "--prefix-cache to measure cross-request reuse")
+    ap.add_argument("--n-templates", type=int, default=2,
+                    help="distinct shared prompt heads in the template mix")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the cross-request prefix cache "
+                    "(sampling/prefix_cache.py) in every engine; per-point "
+                    "and headline prefix_hit_rate fields are emitted")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--scheduler", choices=("fcfs", "slo"), default="fcfs")
     ap.add_argument("--min-headroom-s", type=float, default=0.0,
@@ -277,6 +310,7 @@ def main() -> int:
             cache_dtype=cache_dtype,
             max_backlog_pages=args.max_backlog_pages or None,
             scheduler=sched,
+            prefix_cache=bool(args.prefix_cache),
         )
 
     # Warm EVERY (decode-chunk tail x page bucket) program the workload
@@ -287,14 +321,27 @@ def main() -> int:
     # ~1s on this host — enough to swamp a timed point's percentiles. The
     # jits are module-level, so every per-point engine dispatches warm.
     S = cfg.block_size
+    # The warm engine runs prefix-enabled too (make_engine): the cache is
+    # page-table indirection over the SAME program set — the grid below
+    # stays exhaustive over the prefix-cache path with zero extra shapes,
+    # and a warm run proving that is cheaper than trusting it.
     warm = make_engine()
     _warm_compile_grid(warm, cfg, args.decode_chunk, args.page_size, args.seed)
+
+    # Shared prompt heads for the template mixture: ~3 pages each, built
+    # once per seed (see _mixture on why once-per-seed matters).
+    template_rng = np.random.default_rng(args.seed + 31)
+    templates = [
+        template_rng.integers(0, cfg.vocab_size, 3 * args.page_size, np.int64)
+        for _ in range(args.n_templates)
+    ] if args.template_frac > 0.0 else []
 
     points = []
     for pi, rate in enumerate(rates):
         point_rng = np.random.default_rng(args.seed + 1000 * pi)
         reqs = _mixture(
-            point_rng, args.n_requests, S, cfg.vocab_size, args.long_frac
+            point_rng, args.n_requests, S, cfg.vocab_size, args.long_frac,
+            templates=templates, template_frac=args.template_frac,
         )
         arrivals = _arrivals(
             args.process, rate, args.n_requests, point_rng, args.burst_size
@@ -312,12 +359,17 @@ def main() -> int:
             return records
 
         records = asyncio.run(run_point())
-        points.append(
-            _point_stats(
-                rate, records, args.error_budget,
-                args.slo_ttft_ms, args.slo_tpot_ms,
-            )
+        stats = _point_stats(
+            rate, records, args.error_budget,
+            args.slo_ttft_ms, args.slo_tpot_ms,
         )
+        if args.prefix_cache:
+            # Engine-side observability through the front door's stats()
+            # passthrough — what a deployment's metrics scrape would read.
+            stats["prefix_hit_rate"] = round(
+                server.stats()["prefix"]["hit_rate"], 4
+            )
+        points.append(stats)
 
     worst = points[-1]  # rates ascending by convention: report the hottest
     print(
@@ -330,6 +382,8 @@ def main() -> int:
                 "seed": args.seed,
                 "n_requests": args.n_requests,
                 "long_frac": args.long_frac,
+                "template_frac": args.template_frac or None,
+                "prefix_cache": bool(args.prefix_cache),
                 "ttl_s": args.ttl_s or None,
                 "error_budget": args.error_budget,
                 "slo_ttft_ms": args.slo_ttft_ms or None,
@@ -351,6 +405,7 @@ def main() -> int:
                 "tpot_p95_ms": worst["tpot_p95_ms"],
                 "shed_frac": worst["shed_frac"],
                 "timeout_frac": worst["timeout_frac"],
+                "prefix_hit_rate": worst.get("prefix_hit_rate"),
                 "slo_ok": bool(all(p["slo_ok"] for p in points)),
             }
         )
