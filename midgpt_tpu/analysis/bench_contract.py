@@ -236,6 +236,89 @@ def check_serve_prefix_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_tp_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --tp profile: the same greedy trace through a
+    single-chip engine and a tensor-parallel mesh-sharded engine, per cache
+    mode (base dtype / int8 / self-draft speculation). The load-bearing
+    invariant is match_* == 1.0 EXACTLY for every mode — tp sharding splits
+    head-aligned einsums whose all-reduce restores the same f32 partials a
+    single chip computes, so any token divergence means a wrong sharding
+    spec or a torn collective, not noise (tests/test_tp_serving.py pins the
+    same matrix). Per-shard HBM arithmetic is checked exactly: the pool is
+    sharded on the head axis, so each shard holds total/tp bytes."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "max_slots": (int,),
+            "page_size": (int,),
+            "tp": (int,),
+            "n_devices": (int,),
+            "mesh": (dict,),
+            "base_dtype": (str,),
+            "model": (dict,),
+            "train_steps": (int,),
+            "train_loss": Number,
+            "draft_layers": (int,),
+            "spec_k_max": (int,),
+            "match_f32": Number,
+            "match_int8": Number,
+            "match_spec": Number,
+            "single_tok_s_f32": Number,
+            "single_tok_s_int8": Number,
+            "single_tok_s_spec": Number,
+            "tp_tok_s_f32": Number,
+            "tp_tok_s_int8": Number,
+            "tp_tok_s_spec": Number,
+            "num_pages": (int,),
+            "int8_num_pages": (int,),
+            "cache_hbm_bytes": (int,),
+            "cache_hbm_bytes_per_shard": (int,),
+            "hbm_per_slot_per_shard_bytes": (int,),
+            "int8_cache_hbm_bytes_per_shard": (int,),
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_tp":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_tp'"
+        )
+    ntp = rec.get("tp")
+    if isinstance(ntp, int) and ntp < 2:
+        problems.append(f"tp {ntp} < 2 — the tp profile requires a sharded mesh")
+    mesh = rec.get("mesh")
+    if isinstance(mesh, dict) and isinstance(ntp, int) and mesh.get("tp") != ntp:
+        problems.append(f"mesh {mesh} does not carry tp={ntp}")
+    for mode in ("f32", "int8", "spec"):
+        m = rec.get(f"match_{mode}")
+        if isinstance(m, Number) and m != 1.0:
+            problems.append(
+                f"match_{mode} {m} != 1.0 — tp sharding must be bit-invisible "
+                "to greedy streams"
+            )
+    total = rec.get("cache_hbm_bytes")
+    shard = rec.get("cache_hbm_bytes_per_shard")
+    slot = rec.get("hbm_per_slot_per_shard_bytes")
+    slots = rec.get("max_slots")
+    if isinstance(total, int) and isinstance(shard, int) and isinstance(ntp, int):
+        if shard * ntp != total:
+            problems.append(
+                f"per-shard bytes {shard} * tp {ntp} != pool bytes {total}"
+            )
+    if isinstance(shard, int) and isinstance(slot, int) and isinstance(slots, int):
+        if slots > 0 and slot != shard // slots:
+            problems.append(
+                f"hbm_per_slot_per_shard_bytes {slot} != "
+                f"{shard} // max_slots {slots}"
+            )
+    return problems
+
+
 def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     """tools/loadgen.py profile: TTFT/TPOT percentiles + shed fraction
     under a seeded arrival process, at >= 2 offered-load points (one point
@@ -344,6 +427,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "serve": check_serve_bench,
     "serve_spec": check_serve_spec_bench,
     "serve_prefix": check_serve_prefix_bench,
+    "serve_tp": check_serve_tp_bench,
     "serve_slo": check_serve_slo_bench,
     "graftcheck": check_graftcheck,
 }

@@ -425,4 +425,107 @@ def run_audit() -> tp.Dict[str, tp.Any]:
                 f"{label}-sized copies inside the {name} loop: "
                 + str({b: ls[:1] for b, ls in copies.items() if ls})
             )
+
+    # ------------------------------------------------------------------
+    # tp serving mesh: per-program in-loop collective census
+    # ------------------------------------------------------------------
+    # The mesh-sharded engine's perf claim (docs/SERVING.md "Mesh-sharded
+    # serving") is that tp decode pays ONLY the megatron activation
+    # collectives — two all-reduces per layer per step, nothing else, and
+    # in particular zero pool/scale traffic: the pools shard heads over
+    # 'tp' and never cross shards. Audited on abstractly-lowered SHARDED
+    # programs (ShapeDtypeStruct + NamedSharding; the partitioned modules
+    # show per-shard pool shapes, which is what the copy census greps).
+    # Budget per while body: 2 * n_layer all-reduces for the step-scan
+    # programs (layers unrolled inside the body), 2 for the layer-scan
+    # verify body (the body IS one layer), zero all-gather / all-to-all /
+    # reduce-scatter / collective-permute anywhere in any loop.
+    if len(jax.devices()) >= 2:
+        from jax.sharding import NamedSharding
+
+        from midgpt_tpu.parallel.serve_tp import (
+            make_serve_mesh,
+            serve_cache_specs,
+            serve_param_specs,
+        )
+
+        smesh = make_serve_mesh(tp_size=2)
+        n_tp = 2
+        report["tp_mesh"] = {"tp": n_tp, "data": 1}
+        # head-aligned qkv shards need the split3 einsum order — the same
+        # config switch ServeEngine(mesh=...) makes (training/train.py)
+        mc3 = dataclasses.replace(mc, qkv_proj="split3")
+        mc3_scan = dataclasses.replace(mc_scan, qkv_proj="split3")
+        draft3_cfg = dataclasses.replace(draft_cfg, qkv_proj="split3")
+
+        def _shard_abs(tree, specs):
+            return jax.tree.map(
+                lambda l, s: jax.ShapeDtypeStruct(
+                    l.shape, l.dtype, sharding=NamedSharding(smesh, s)
+                ),
+                tree,
+                specs,
+            )
+
+        params_tp = _shard_abs(params_abs, serve_param_specs(params_abs, smesh))
+        draft_tp = _shard_abs(draft_abs, serve_param_specs(draft_abs, smesh))
+        cache_tp = _shard_abs(cache_abs, serve_cache_specs(cache_abs))
+        cache8_tp = _shard_abs(cache8_abs, serve_cache_specs(cache8_abs))
+        sds = jax.ShapeDtypeStruct
+        i32, b1 = jnp.int32, jnp.bool_
+
+        def _decode_lower(cfg, cache):
+            return _serve_decode_chunk.lower(
+                cfg, params_tp, sds((B,), i32), cache,
+                sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
+                4, 0.0, None, None, "gather", None, smesh,
+            ).compile().as_text()
+
+        tp_programs = {
+            "tp_decode": (_decode_lower(mc3, cache_tp), 2 * mc.n_layer),
+            "tp_decode_int8": (_decode_lower(mc3, cache8_tp), 2 * mc.n_layer),
+            "tp_verify": (
+                _spec_verify_chunk.lower(
+                    mc3_scan, params_tp, sds((B,), i32), sds((K, B), i32),
+                    sds((K, B, mc.vocab_size), jnp.float32), cache_tp,
+                    sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
+                    0.0, None, None, "gather", None, smesh,
+                ).compile().as_text(),
+                2,  # layer-scan body = one layer = one megatron pair
+            ),
+            "tp_draft_int8": (
+                _spec_draft_chunk.lower(
+                    draft3_cfg, draft_tp, sds((B,), i32), cache8_tp,
+                    sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
+                    K, 0.0, None, None, "gather", None, smesh,
+                ).compile().as_text(),
+                2 * draft_cfg.n_layer,
+            ),
+        }
+        # per-SHARD pool shapes: H/tp heads per shard (head axis 1 of the
+        # pools, axis 2 of the scale side buffers)
+        h_shard = mc.n_head // n_tp
+        shard_shapes = (
+            f"f32[{mc.n_layer},{h_shard},9,8,{mc.head_dim}]",
+            f"s8[{mc.n_layer},{h_shard},9,8,{mc.head_dim}]",
+            f"f32[{mc.n_layer},9,{h_shard},8]",
+        )
+        other_ops = tuple(o for o in COLLECTIVE_OPS if o != "all-reduce")
+        for name, (hlo, budget) in tp_programs.items():
+            assert_no_while_body_collectives(hlo, ops=other_ops)
+            ar = while_body_collectives(hlo, ops=("all-reduce",))
+            n_ar = sum(len(ls) for ls in ar.values())
+            report[f"{name}_loop_all_reduces"] = n_ar
+            assert n_ar == budget, (
+                f"{name}: {n_ar} in-loop all-reduces, budget {budget} "
+                "(two megatron activation collectives per layer per step)"
+            )
+            for shape in shard_shapes:
+                copies = while_body_pool_copies(hlo, shape)
+                n_cp = sum(len(ls) for ls in copies.values())
+                assert n_cp == 0, (
+                    f"{name}: {n_cp} in-loop {shape} pool/scale copies — "
+                    "the sharded pool must alias through the loop carry"
+                )
+            report[f"{name}_loop_pool_copies"] = 0
     return report

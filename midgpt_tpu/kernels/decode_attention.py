@@ -65,8 +65,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from jax.sharding import Mesh, PartitionSpec as P
+
 from midgpt_tpu.kernels.flash_attention import M_INIT, MASK, _interpret
 from midgpt_tpu.ops.quant import dequantize_q8
+from midgpt_tpu.utils.compat import shard_map
 
 Array = jax.Array
 
@@ -256,6 +259,24 @@ def paged_attention_gather(
     return jnp.einsum("bhqk,bhkc->bhqc", probs, vg)[:, :, 0]
 
 
+def _tp_shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """Full-MANUAL shard_map over the serving mesh: every named axis is
+    manual (only 'tp' exceeds size 1 on a serve mesh, parallel/serve_tp.py),
+    so the body is a plain per-shard trace — exactly what a Pallas kernel
+    needs, and the one shard_map form the 0.4.37 CPU backend lowers (the
+    partial-manual form aborts there; utils/compat.shard_map docstring).
+    check_vma off: paged attention is pointwise in heads, there is no
+    replication to certify."""
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        axis_names=frozenset(mesh.axis_names),
+        check_vma=False,
+    )
+
+
 def paged_attention(
     q: Array,
     k_pages: Array,
@@ -265,13 +286,35 @@ def paged_attention(
     impl: str = "auto",
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
+    mesh: tp.Optional[Mesh] = None,
 ) -> Array:
     """Dispatch: Pallas kernel on TPU, XLA gather elsewhere (interpret mode
     is orders of magnitude too slow for the serving loop — same policy as
-    ops/attention.py for the flash kernel)."""
+    ops/attention.py for the flash kernel).
+
+    With a tp>1 serving mesh the kernel is invoked PER SHARD through a
+    full-manual shard_map: each tp shard holds H/tp heads of q and of the
+    page pool (+ int8 scale rows), the page table and lengths ride in
+    replicated, and the per-head online-softmax sweep needs no collective at
+    all — the head axis is embarrassingly parallel. The gather lowering
+    ignores `mesh`: it is plain jnp, and GSPMD partitions it from the
+    operand shardings alone."""
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
+        if mesh is not None and mesh.shape["tp"] > 1:
+            quantized = k_scale is not None
+            pool = P("tp", None, None, None)  # (H, pages, page_size, C)
+            in_specs = [P(None, "tp", None), pool, pool, P(), P()]
+            args = [q, k_pages, v_pages, page_table, lengths]
+            if quantized:
+                in_specs += [P(None, "tp", None)] * 2  # (pages, H, page_size)
+                args += [k_scale, v_scale]
+            fn = _tp_shard_map(
+                lambda *a: paged_attention_kernel(*a),
+                mesh, tuple(in_specs), P(None, "tp", None),
+            )
+            return fn(*args)
         return paged_attention_kernel(
             q, k_pages, v_pages, page_table, lengths, k_scale, v_scale
         )
@@ -466,6 +509,7 @@ def paged_verify_attention(
     impl: str = "auto",
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
+    mesh: tp.Optional[Mesh] = None,
 ) -> Array:
     """Batched multi-row paged attention for speculative verification
     (GPT.verify_step_paged): every slot scores its k+1 candidate positions
@@ -476,10 +520,26 @@ def paged_verify_attention(
 
     Dispatch mirrors `paged_attention`: the Pallas multi-row kernel on TPU
     (the compiled verify path, bf16 and int8 — interpret-mode parity in
-    tests/test_quant_cache.py), the XLA gather lowering elsewhere."""
+    tests/test_quant_cache.py), the XLA gather lowering elsewhere; on a
+    tp>1 mesh the kernel runs per shard over H/tp heads via the same
+    full-manual shard_map, collective-free."""
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
+        if mesh is not None and mesh.shape["tp"] > 1:
+            quantized = k_scale is not None
+            pool = P("tp", None, None, None)
+            row_spec = P(None, None, "tp", None)  # q/out (B, T, H, C)
+            in_specs = [row_spec, pool, pool, P(), P()]
+            args = [q, k_pages, v_pages, page_table, counts]
+            if quantized:
+                in_specs += [P(None, "tp", None)] * 2
+                args += [k_scale, v_scale]
+            fn = _tp_shard_map(
+                lambda *a: paged_verify_attention_kernel(*a),
+                mesh, tuple(in_specs), row_spec,
+            )
+            return fn(*args)
         return paged_verify_attention_kernel(
             q, k_pages, v_pages, page_table, counts, k_scale, v_scale
         )

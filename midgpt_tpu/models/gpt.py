@@ -1056,6 +1056,7 @@ class GPT:
         lengths: Array,  # (B,) int32 — tokens already in slot b's cache
         active: Array,  # (B,) bool — False: slot is empty / mid-prefill
         attn_impl: str = "auto",
+        mesh=None,  # Optional[Mesh] — tp serving mesh (parallel/serve_tp.py)
     ) -> tp.Tuple[Array, "PagedKVCache"]:
         """One decode step for B independent requests at B different positions.
 
@@ -1073,7 +1074,14 @@ class GPT:
         The layer loop goes through `_decode_layer_loop` (decode_layer_scan
         applies). Attention dispatches per `attn_impl` — 'auto' is the
         Pallas page-table kernel on TPU, the XLA gather fallback elsewhere
-        (kernels/decode_attention.py).
+        (kernels/decode_attention.py). On a tp>1 serving mesh `mesh` routes
+        the kernel through its per-shard shard_map (heads split over 'tp');
+        everything else in this function is spelled in plain jnp on the
+        batch/feature axes, so GSPMD partitions it from the head-sharded
+        pool and megatron param shardings alone — the only activation
+        collectives are the two per-layer megatron all-reduces
+        (_attn_out_and_mlp's wo and w_down contractions), pinned by the
+        analysis/hlo_audit.py tp census.
 
         Returns (logits (B, V), cache with the B new K/V columns written)."""
         from midgpt_tpu.kernels.decode_attention import paged_attention
@@ -1126,7 +1134,7 @@ class GPT:
             vp, vsp = _layer_pages(cv_all, cvs_all, i)
             att = paged_attention(
                 q1, kp, vp, page_table, attn_counts, impl=attn_impl,
-                k_scale=ksp, v_scale=vsp,
+                k_scale=ksp, v_scale=vsp, mesh=mesh,
             )  # (B, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att[:, None])
             return (x, ck_all, cv_all, cks_all, cvs_all), None
@@ -1154,6 +1162,7 @@ class GPT:
         lengths: Array,  # (B,) int32 — tokens already in slot b's cache
         active: Array,  # (B,) bool
         attn_impl: str = "auto",
+        mesh=None,  # Optional[Mesh] — tp serving mesh (parallel/serve_tp.py)
     ) -> tp.Tuple[Array, "PagedKVCache"]:
         """Score K1 = k+1 candidate tokens per slot in ONE batched paged
         forward — the target side of speculative decoding (sampling/spec.py).
@@ -1222,7 +1231,7 @@ class GPT:
             vp, vsp = _layer_pages(cv_all, cvs_all, i)
             att = paged_verify_attention(
                 q, kp, vp, page_table, attn_counts, impl=attn_impl,
-                k_scale=ksp, v_scale=vsp,
+                k_scale=ksp, v_scale=vsp, mesh=mesh,
             )  # (B, K1, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att.astype(x.dtype))
             return (x, ck_all, cv_all, cks_all, cvs_all), None

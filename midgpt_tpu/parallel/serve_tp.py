@@ -1,0 +1,135 @@
+"""Mesh sharding rules for the SERVING engine: tensor-parallel paged decode
+over a named (data, tp) mesh, plus the per-role submeshes the disaggregated
+prefill/decode deployment (sampling/disagg.py) places its engines on.
+
+Training already proves megatron-TP end to end (parallel/tp.py); serving
+reuses exactly those parameter rules — the (3, D, D) wqkv layout was
+designed so tp shards land on whole heads (models/gpt.py AttentionParams) —
+and adds the one piece training does not have: the paged KV pool. The pool
+is (n_layer, n_head, num_pages, page_size, head_dim) per tensor, so the
+head axis is the natural tp shard: every page of every request splits into
+per-shard head slices, attention is pointwise in heads, and the ONLY
+activation collectives in a tp decode step are the two megatron all-reduces
+per layer that the row-parallel wo/w_down already pay (the in-loop
+collective census in analysis/hlo_audit.py pins exactly that). The int8
+scale side buffers (n_layer, num_pages, n_head, page_size) shard the same
+head axis at position 2.
+
+Deliberately NOT sharded: the page table, lengths, and every other
+scheduler input stay replicated host-side jit inputs — the prefix-cache
+trie, the allocator, and the scheduler policies are untouched host logic,
+which is what keeps "admitting/finishing requests never recompiles" true on
+a mesh (docs/SERVING.md "Mesh-sharded serving").
+
+Serving uses vocab_parallel=False: logits come out replicated, so the
+engine's host-side first-token argmax and the in-graph greedy sample both
+read full-vocab logits with no extra collective inside the decode loop.
+
+`make_serve_mesh` builds the mesh directly over an explicit device count
+(unlike parallel/mesh.make_mesh, which spans ALL devices — a serving
+deployment routinely carves a submesh per engine role out of one slice).
+All six named axes (parallel/mesh.AXES) are present so the training-side
+spec rules apply verbatim; only 'data' and 'tp' exceed size 1 here.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from midgpt_tpu.parallel.mesh import AXES
+from midgpt_tpu.parallel.tp import tp_param_specs
+
+# PagedKVCache pool layout (L, H, P, ps, C): heads at axis 1.
+POOL_SPEC = P(None, "tp", None, None, None)
+# int8 scale side buffers (L, P, H, ps): heads at axis 2.
+SCALE_SPEC = P(None, None, "tp", None)
+
+
+def make_serve_mesh(
+    tp_size: int = 1,
+    data: int = 1,
+    devices: tp.Optional[tp.Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A (data, tp) serving mesh over the first data*tp devices.
+
+    'data' is the engine-ROLE axis (disaggregated prefill/decode instances,
+    sampling/disagg.py — each role engine lives on one data row via
+    `role_submeshes`), 'tp' the tensor-parallel axis within a role. The
+    other four named axes are size 1 so parallel/tp.py's rules (which index
+    mesh.shape['fsdp']/['ep']) work unchanged."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = data * tp_size
+    if n > len(devices):
+        raise ValueError(
+            f"serve mesh data={data} x tp={tp_size} needs {n} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(data, 1, 1, tp_size, 1, 1)
+    return Mesh(arr, axis_names=AXES)
+
+
+def role_submeshes(mesh: Mesh) -> tp.List[Mesh]:
+    """One (data=1, tp) submesh per 'data' row — the per-role engine meshes
+    of a disaggregated deployment. Row 0 is the prefill role by convention
+    (sampling/disagg.py)."""
+    devs = mesh.devices  # (data, 1, 1, tp, 1, 1)
+    return [Mesh(devs[r : r + 1], axis_names=AXES) for r in range(devs.shape[0])]
+
+
+def serve_param_specs(params: tp.Any, mesh: Mesh) -> tp.Any:
+    """Megatron tp specs for a serving engine's params: the training rule
+    (parallel/tp.py) with vocab_parallel OFF (module docstring) and no size
+    gate — serving replicates nothing shardable, however small the model
+    (the CPU test mesh runs 32-dim toys)."""
+    return tp_param_specs(
+        params, mesh, shard_model=True, min_size=0, vocab_parallel=False
+    )
+
+
+def serve_cache_specs(cache: tp.Any) -> tp.Any:
+    """PartitionSpec pytree matching a PagedKVCache: pools head-sharded over
+    'tp', int8 scale side buffers likewise (layouts in the module
+    docstring). Works on concrete caches and ShapeDtypeStruct trees alike —
+    bf16 caches simply have no scale leaves."""
+    from midgpt_tpu.models.gpt import PagedKVCache
+
+    has_scales = cache.k_scale is not None
+    return PagedKVCache(
+        k=POOL_SPEC,
+        v=POOL_SPEC,
+        k_scale=SCALE_SPEC if has_scales else None,
+        v_scale=SCALE_SPEC if has_scales else None,
+    )
+
+
+def put_sharded(tree: tp.Any, specs: tp.Any, mesh: Mesh) -> tp.Any:
+    """device_put a pytree with NamedShardings (engine init: params and
+    freshly-initialized pools land sharded once; every later update stays
+    sharded through the jits' output constraints)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def constrain_cache(cache: tp.Any, mesh: Mesh) -> tp.Any:
+    """with_sharding_constraint the pool layout onto a returned cache
+    (inside jit). Pinning the OUT-sharding to the IN-sharding is what keeps
+    the donated pool's buffers reusable across rounds — without it GSPMD is
+    free to pick a different output layout and the donation degrades to a
+    copy + reshard every serve round."""
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
+        cache,
+        serve_cache_specs(cache),
+    )
+
+
+def mesh_shape(mesh: tp.Optional[Mesh]) -> tp.Optional[tp.Dict[str, int]]:
+    """{'data': d, 'tp': t} for stats()/JSON reporting, None when unsharded."""
+    if mesh is None:
+        return None
+    return {"data": int(mesh.shape["data"]), "tp": int(mesh.shape["tp"])}

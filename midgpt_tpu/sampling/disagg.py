@@ -1,0 +1,431 @@
+"""Disaggregated prefill/decode serving: two ServeEngine roles bridged by a
+page-handoff queue (docs/SERVING.md "Mesh-sharded serving").
+
+Prefill and decode want opposite machines: prefill is compute-bound batch
+work (long chunks, few slots), decode is HBM-bound latency work (many
+slots, short chunks). A monolithic engine time-slices both on one set of
+chips and each interferes with the other's SLO (FastUSP's multi-level
+split, PAPERS.md). Disaggregation runs a prefill-heavy engine instance and
+a decode-heavy one — on the two rows of a (data=2, tp) serving mesh
+(parallel/serve_tp.role_submeshes), or unsharded side by side on the CPU
+test mesh — and moves each request between them exactly once, at the
+prefill/decode boundary.
+
+The handoff rides machinery previous PRs already built, which is why it is
+small:
+
+  * chunked prefill makes the prefill role preemptible (a request never
+    holds the engine longer than one chunk), and `max_new_tokens=1` makes
+    "prefill + first token" a complete ServeEngine request — the prefill
+    role needs no new scheduler states;
+  * the prefix-cache trie already expresses "these pages hold tokens
+    0..n": at prefill finish the request's complete prompt pages sit in
+    the trie, `match` hands them (referenced) to the handoff, and on the
+    decode side `release(..., n_shared=0)` donates the adopted copies back
+    into the DECODE trie, so the decode engine's ordinary admission path
+    re-matches them and skips prompt re-prefill — the decode role needs no
+    new admission states either;
+  * page content moves as a host-gathered block and lands through one
+    jitted scatter (`_adopt_pages`, donated pool, oob-padded page indices
+    like every engine scatter), so the adopt is one compiled program per
+    (page-count bucket, dtype) — the same bucketing discipline that keeps
+    the serving jits' compile set mix-independent.
+
+Greedy parity: the decode role's prompt is `prompt + [first_token]`; its
+prefill recomputes exactly the positions the handoff did not ship and its
+first host-side argmax reproduces the monolithic engine's second token
+(prefill-logits/decode-step parity is the engine's founding invariant,
+tests/test_sampling.py), so a disaggregated greedy stream is token-for-
+token the monolithic stream (pinned by tests/test_tp_serving.py). The
+queue is lossy-safe in both directions: a handoff that cannot get decode
+pool pages degrades to plain re-prefill on the decode side (correct, just
+slower), and a timed-out request propagates its timeout status.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import time
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from midgpt_tpu.models.gpt import GPTConfig, GPTParams, PagedKVCache
+from midgpt_tpu.sampling.serve import (
+    BackpressureError,
+    FinishedRequest,
+    ServeEngine,
+)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def _adopt_pages(mesh, cache, dst, blocks):
+    """Scatter handed-off page blocks into the decode pool at physical
+    pages `dst` ((n,) int32, padded to a power-of-two bucket with
+    `num_pages` so pad writes drop under XLA oob-scatter semantics — the
+    same funnel shape as the engine's K/V column writes). `blocks` carries
+    'k'/'v' (L, H, n, ps, C) and, int8 pools, 'k_scale'/'v_scale'
+    (L, n, H, ps); its key set and the dst bucket are the compile keys.
+    The pool is donated: an adopt is an in-place page write, not a pool
+    copy. `mesh` is static like the serving jits' trailing mesh arg and
+    pins the sharded pool's out-sharding (serve._maybe_constrain)."""
+    k = cache.k.at[:, :, dst].set(blocks["k"].astype(cache.k.dtype))
+    v = cache.v.at[:, :, dst].set(blocks["v"].astype(cache.v.dtype))
+    ks, vs = cache.k_scale, cache.v_scale
+    if "k_scale" in blocks:
+        ks = ks.at[:, dst].set(blocks["k_scale"])
+        vs = vs.at[:, dst].set(blocks["v_scale"])
+    new = PagedKVCache(k=k, v=v, k_scale=ks, v_scale=vs)
+    if mesh is not None:
+        from midgpt_tpu.parallel.serve_tp import constrain_cache
+
+        new = constrain_cache(new, mesh)
+    return new
+
+
+@dataclasses.dataclass
+class HandoffItem:
+    """One request crossing the prefill->decode boundary: identity and
+    budget, the prefill role's first token (with its wall-clock time, so
+    TTFT survives the handoff), and the host-gathered content of its
+    complete prompt pages."""
+
+    uid: int  # DisaggServe uid
+    prompt: np.ndarray  # (T0,) int32
+    first_token: int
+    first_time: float
+    max_new_tokens: int  # ORIGINAL budget (decode role gets it minus 1)
+    eos_id: tp.Optional[int]
+    deadline: tp.Optional[float]
+    blocks: tp.Dict[str, np.ndarray]  # page content, keys as _adopt_pages
+    n_pages: int
+
+
+class PageHandoffQueue:
+    """FIFO of HandoffItems with transfer accounting. Host-side and
+    process-local here (both roles live in one process on the test mesh);
+    the counters are the interface a cross-host transport would have to
+    honor — bytes_copied is the KV traffic the disaggregation actually
+    moves, the number to weigh against the prompt re-prefill FLOPs it
+    saves."""
+
+    def __init__(self):
+        self._q: tp.Deque[HandoffItem] = collections.deque()
+        self.enqueued = 0
+        self.dequeued = 0
+        self.pages_copied = 0
+        self.bytes_copied = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, item: HandoffItem) -> None:
+        self.enqueued += 1
+        self.pages_copied += item.n_pages
+        self.bytes_copied += sum(b.nbytes for b in item.blocks.values())
+        self._q.append(item)
+
+    def pop(self) -> HandoffItem:
+        self.dequeued += 1
+        return self._q.popleft()
+
+    def requeue(self, item: HandoffItem) -> None:
+        """Return a popped item to the FRONT (decode admission refused it;
+        it keeps its place)."""
+        self.dequeued -= 1
+        self._q.appendleft(item)
+
+    def stats(self) -> tp.Dict[str, int]:
+        return {
+            "depth": len(self._q),
+            "enqueued": self.enqueued,
+            "dequeued": self.dequeued,
+            "pages_copied": self.pages_copied,
+            "bytes_copied": self.bytes_copied,
+        }
+
+
+class DisaggServe:
+    """A prefill-role ServeEngine and a decode-role ServeEngine joined by a
+    PageHandoffQueue (module docstring).
+
+    `mesh`, when given, must carry data >= 2: role r lives on
+    `role_submeshes(mesh)[r]` — row 0 prefill, row 1 decode — so the two
+    roles occupy disjoint devices and each is tp-sharded across its row.
+    With mesh=None both roles run unsharded (the CPU parity
+    configuration). `engine_kw` is shared by both roles;
+    `prefill_kw`/`decode_kw` override per role (the point of
+    disaggregation: e.g. a long prefill_chunk on the prefill role, more
+    slots on the decode role). Greedy only (temperature=0): the handoff
+    carries no RNG stream, and parity with a monolithic engine is the
+    contract."""
+
+    def __init__(
+        self,
+        config: GPTConfig,
+        params: GPTParams,
+        *,
+        mesh=None,
+        prefill_kw: tp.Optional[tp.Dict[str, tp.Any]] = None,
+        decode_kw: tp.Optional[tp.Dict[str, tp.Any]] = None,
+        clock: tp.Callable[[], float] = time.perf_counter,
+        **engine_kw,
+    ):
+        if engine_kw.get("temperature", 0.0) != 0.0:
+            raise ValueError("DisaggServe is greedy-only (module docstring)")
+        if engine_kw.pop("prefix_cache", True) is not True:
+            raise ValueError(
+                "DisaggServe requires the prefix cache: the trie IS the "
+                "handoff's page-ownership ledger"
+            )
+        pf_mesh = dec_mesh = None
+        if mesh is not None:
+            from midgpt_tpu.parallel.serve_tp import role_submeshes
+
+            roles = role_submeshes(mesh)
+            if len(roles) < 2:
+                raise ValueError(
+                    "disaggregation needs a mesh with data >= 2 (one row "
+                    "per role); got data="
+                    f"{int(mesh.shape['data'])}"
+                )
+            pf_mesh, dec_mesh = roles[0], roles[1]
+        self._clock = clock
+        self.prefill = ServeEngine(
+            config, params, prefix_cache=True, clock=clock, mesh=pf_mesh,
+            **{**engine_kw, **(prefill_kw or {})},
+        )
+        self.decode = ServeEngine(
+            config, params, prefix_cache=True, clock=clock, mesh=dec_mesh,
+            **{**engine_kw, **(decode_kw or {})},
+        )
+        self.queue = PageHandoffQueue()
+        self.finished: tp.Dict[int, FinishedRequest] = {}
+        # disagg uid -> (prompt, max_new, eos, deadline), keyed twice over
+        # the role engines' own uid spaces while a request is inside one.
+        self._pf_pending: tp.Dict[int, tp.Tuple[int, np.ndarray, int,
+                                                tp.Optional[int],
+                                                tp.Optional[float]]] = {}
+        self._dec_pending: tp.Dict[int, HandoffItem] = {}
+        self._uid = 0
+        # Handoffs that could not get decode-pool pages and fell back to
+        # plain re-prefill on the decode role (correct, just slower).
+        self.fallback_reprefills = 0
+
+    # -- public surface ------------------------------------------------
+
+    def submit(
+        self,
+        prompt: tp.Sequence[int],
+        max_new_tokens: int,
+        eos_id: tp.Optional[int] = None,
+        ttl_s: tp.Optional[float] = None,
+    ) -> int:
+        """Queue a request on the PREFILL role (budget 1: prefill + first
+        token is a complete request there). Backpressure propagates —
+        shedding happens at the front door, not mid-pipeline."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        deadline = None if ttl_s is None else self._clock() + ttl_s
+        pf_uid = self.prefill.submit(prompt, 1, eos_id=None, ttl_s=ttl_s)
+        uid = self._uid
+        self._uid += 1
+        self._pf_pending[pf_uid] = (uid, prompt, max_new_tokens, eos_id, deadline)
+        return uid
+
+    @property
+    def idle(self) -> bool:
+        return (
+            not self._pf_pending
+            and not self._dec_pending
+            and not len(self.queue)
+            and self.prefill.idle
+            and self.decode.idle
+        )
+
+    def run(self) -> tp.Dict[int, FinishedRequest]:
+        while not self.idle:
+            self.step()
+        return self.finished
+
+    def step(self) -> None:
+        """One pipeline tick: advance prefill, drain its finishes into the
+        handoff queue, adopt queued handoffs into the decode role, advance
+        decode, drain its finishes. The two engine step()s are independent
+        device programs on disjoint (sub)meshes — a real deployment
+        overlaps them; the host loop here interleaves them, which is
+        enough for every invariant the tests pin."""
+        if not self.prefill.idle:
+            self.prefill.step()
+        self._drain_prefill()
+        self._drain_queue()
+        if not self.decode.idle:
+            self.decode.step()
+        self._drain_decode()
+
+    def stats(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "queue": self.queue.stats(),
+            "fallback_reprefills": self.fallback_reprefills,
+            "prefill": self.prefill.stats(),
+            "decode": self.decode.stats(),
+        }
+
+    # -- internals -----------------------------------------------------
+
+    def _finish(self, fr: FinishedRequest) -> None:
+        self.finished[fr.uid] = fr
+
+    def _drain_prefill(self) -> None:
+        done = [u for u in self._pf_pending if u in self.prefill.finished]
+        for pf_uid in done:
+            uid, prompt, max_new, eos_id, deadline = self._pf_pending.pop(pf_uid)
+            fr = self.prefill.finished[pf_uid]
+            if fr.status != "ok":
+                self._finish(
+                    FinishedRequest(uid, fr.tokens, fr.token_times, fr.status)
+                )
+                continue
+            first = int(fr.tokens[len(prompt)])
+            first_time = fr.token_times[0]
+            if max_new == 1 or (eos_id is not None and first == eos_id):
+                self._finish(
+                    FinishedRequest(
+                        uid,
+                        np.append(prompt, np.int32(first)),
+                        [first_time],
+                        "ok",
+                    )
+                )
+                continue
+            self.queue.push(self._gather_pages(
+                uid, prompt, first, first_time, max_new, eos_id, deadline
+            ))
+
+    def _gather_pages(
+        self, uid, prompt, first, first_time, max_new, eos_id, deadline
+    ) -> HandoffItem:
+        """Reference the request's complete prompt pages out of the
+        prefill trie, land their content on the host, and drop the refs
+        (the entries stay in the PREFILL trie for future shared-template
+        hits — the handoff copies, it does not steal)."""
+        pc = self.prefill.prefix_cache
+        mr = pc.match(prompt, max_tokens=len(prompt) - 1)
+        n = len(mr.pages)
+        blocks: tp.Dict[str, np.ndarray] = {}
+        if n:
+            idx = jnp.asarray(mr.pages, jnp.int32)
+            cache = self.prefill.cache
+            blocks["k"] = np.asarray(jnp.take(cache.k, idx, axis=2))
+            blocks["v"] = np.asarray(jnp.take(cache.v, idx, axis=2))
+            if cache.k_scale is not None:
+                blocks["k_scale"] = np.asarray(
+                    jnp.take(cache.k_scale, idx, axis=1)
+                )
+                blocks["v_scale"] = np.asarray(
+                    jnp.take(cache.v_scale, idx, axis=1)
+                )
+            ps = self.prefill.page_size
+            self.prefill.allocator.free(
+                pc.release(prompt[: n * ps], mr.pages, n)
+            )
+        return HandoffItem(
+            uid=uid, prompt=prompt, first_token=first, first_time=first_time,
+            max_new_tokens=max_new, eos_id=eos_id, deadline=deadline,
+            blocks=blocks, n_pages=n,
+        )
+
+    def _drain_queue(self) -> None:
+        while len(self.queue):
+            item = self.queue.pop()
+            if item.deadline is not None:
+                remaining = item.deadline - self._clock()
+                if remaining <= 0:
+                    self._finish(
+                        FinishedRequest(
+                            item.uid,
+                            np.append(item.prompt, np.int32(item.first_token)),
+                            [item.first_time],
+                            "timeout",
+                        )
+                    )
+                    continue
+            else:
+                remaining = None
+            dec_prompt = np.append(item.prompt, np.int32(item.first_token))
+            try:
+                dec_uid = self.decode.submit(
+                    dec_prompt, item.max_new_tokens - 1, item.eos_id,
+                    ttl_s=remaining,
+                )
+            except BackpressureError:
+                self.queue.requeue(item)
+                break  # decode role is full; retry next tick
+            self._adopt(item)
+            self._dec_pending[dec_uid] = item
+
+    def _adopt(self, item: HandoffItem) -> None:
+        """Allocate decode-pool pages, scatter the handed-off content into
+        them, and donate them to the DECODE trie at refcount 0 — from here
+        the decode engine's ordinary admission match finds them and skips
+        the prompt prefill. Falls back to nothing (plain re-prefill) when
+        the decode pool cannot free enough pages."""
+        n = item.n_pages
+        if n == 0:
+            return
+        eng = self.decode
+        dst = eng.allocator.alloc(n)
+        if dst is None:
+            # Reclaim unreferenced trie pages, the engine's own pressure
+            # valve, then retry once.
+            eng.allocator.free(
+                eng.prefix_cache.evict(n - eng.allocator.free_count)
+            )
+            dst = eng.allocator.alloc(n)
+        if dst is None:
+            self.fallback_reprefills += 1
+            return
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        pad = bucket - n
+        dst_j = jnp.asarray(
+            np.asarray(dst + [eng.cache.num_pages] * pad, np.int32)
+        )
+        def _pad(blk: np.ndarray, axis: int):
+            if pad == 0:
+                return jnp.asarray(blk)
+            shape = list(blk.shape)
+            shape[axis] = pad
+            return jnp.asarray(
+                np.concatenate([blk, np.zeros(shape, blk.dtype)], axis=axis)
+            )
+
+        blocks = {
+            key: _pad(blk, 1 if key.endswith("scale") else 2)
+            for key, blk in item.blocks.items()
+        }
+        eng.cache = _adopt_pages(eng.mesh, eng.cache, dst_j, blocks)
+        ps = eng.page_size
+        eng.allocator.free(
+            eng.prefix_cache.release(item.prompt[: n * ps], dst, 0)
+        )
+
+    def _drain_decode(self) -> None:
+        done = [u for u in self._dec_pending if u in self.decode.finished]
+        for dec_uid in done:
+            item = self._dec_pending.pop(dec_uid)
+            fr = self.decode.finished[dec_uid]
+            # fr.tokens is (prompt + first) + the decode role's generation —
+            # exactly the monolithic stream.
+            self._finish(
+                FinishedRequest(
+                    item.uid,
+                    fr.tokens,
+                    [item.first_time] + list(fr.token_times),
+                    fr.status,
+                )
+            )

@@ -125,15 +125,30 @@ from midgpt_tpu.sampling.spec import speculative_accept
 Array = jax.Array
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5,))
-def _serve_prefill_chunk(config, params, tokens, start, n_valid, cache, page_table_row):
-    return GPT.prefill_paged_chunk(
+def _maybe_constrain(cache, mesh):
+    """Pin a tp-sharded pool's out-sharding to its in-sharding inside the
+    serving jits (no-op unsharded). Without the constraint GSPMD may pick a
+    different output layout for the donated pool and the round-to-round
+    donation degrades to a copy+reshard (parallel/serve_tp.constrain_cache)."""
+    if mesh is None:
+        return cache
+    from midgpt_tpu.parallel.serve_tp import constrain_cache
+
+    return constrain_cache(cache, mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(5,))
+def _serve_prefill_chunk(
+    config, params, tokens, start, n_valid, cache, page_table_row, mesh=None
+):
+    logits, cache = GPT.prefill_paged_chunk(
         config, params, tokens, start, n_valid, cache, page_table_row
     )
+    return logits, _maybe_constrain(cache, mesh)
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11), donate_argnums=(3,)
+    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11, 13), donate_argnums=(3,)
 )
 def _serve_decode_chunk(
     config,
@@ -149,6 +164,7 @@ def _serve_decode_chunk(
     top_p,
     attn_impl: str,
     key=None,
+    mesh=None,  # static (Mesh hashes) — tp serving mesh, None = single chip
 ):
     """n_steps decode+sample steps for the whole slot batch as ONE device
     program. Inactive slots hold their token and length (their writes land
@@ -162,8 +178,9 @@ def _serve_decode_chunk(
             k = None
         logits, cache = GPT.decode_step_paged(
             config, params, token, cache, page_table, lengths, active,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, mesh=mesh,
         )
+        cache = _maybe_constrain(cache, mesh)
         if temperature == 0.0:
             nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
         else:
@@ -179,7 +196,7 @@ def _serve_decode_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11), donate_argnums=(3,)
+    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11, 13), donate_argnums=(3,)
 )
 def _spec_draft_chunk(
     config,  # the DRAFT model's GPTConfig
@@ -195,6 +212,7 @@ def _spec_draft_chunk(
     top_p,
     attn_impl: str,
     key=None,
+    mesh=None,  # static — tp serving mesh, None = single chip
 ):
     """k_steps autoregressive draft proposals for the whole slot batch as
     ONE device program: a scan of paged decode steps of the draft model
@@ -210,8 +228,9 @@ def _spec_draft_chunk(
             key, k = jax.random.split(key)
         logits, cache = GPT.decode_step_paged(
             config, params, token, cache, page_table, lengths, active,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, mesh=mesh,
         )
+        cache = _maybe_constrain(cache, mesh)
         lf = logits.astype(jnp.float32)
         if temperature == 0.0:
             probs = jax.nn.softmax(lf, axis=-1)
@@ -231,7 +250,7 @@ def _spec_draft_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 9, 10, 11, 12), donate_argnums=(5,)
+    jax.jit, static_argnums=(0, 9, 10, 11, 12, 14), donate_argnums=(5,)
 )
 def _spec_verify_chunk(
     config,
@@ -248,6 +267,7 @@ def _spec_verify_chunk(
     top_p,
     attn_impl: str,
     key=None,
+    mesh=None,  # static — tp serving mesh, None = single chip
 ):
     """One batched paged verify forward over [pending, d_1..d_k] plus the
     rejection sampler (sampling/spec.py): returns (cache, n_accept (B,),
@@ -259,8 +279,9 @@ def _spec_verify_chunk(
     )  # (B, k+1)
     logits, cache = GPT.verify_step_paged(
         config, params, tokens, cache, page_table, lengths, active,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, mesh=mesh,
     )
+    cache = _maybe_constrain(cache, mesh)
     n_accept, out = speculative_accept(
         logits,
         jnp.transpose(draft_probs, (1, 0, 2)),
@@ -445,8 +466,47 @@ class ServeEngine:
         clock: tp.Callable[[], float] = time.perf_counter,
         on_token: tp.Optional[tp.Callable[[int, int, float], None]] = None,
         on_finish: tp.Optional[tp.Callable[["FinishedRequest"], None]] = None,
+        mesh=None,  # Optional[jax.sharding.Mesh] — parallel/serve_tp.py
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
+        # ---- tp serving mesh (docs/SERVING.md "Mesh-sharded serving") ----
+        # Params shard by the megatron training rules (vocab-parallel off so
+        # logits stay replicated for the host-side first-token argmax), the
+        # paged pools shard heads over 'tp', and EVERY scheduler-facing jit
+        # input — page tables, lengths, tokens — stays a replicated host
+        # array: the trie/allocator/scheduler below never learn the mesh
+        # exists. The mesh rides the serving jits as a trailing static arg,
+        # so a sharded and an unsharded engine in one process keep disjoint
+        # compile-cache entries and mesh=None stays bit-for-bit the
+        # single-chip behavior.
+        self.mesh = mesh
+        if mesh is not None:
+            from midgpt_tpu.parallel import serve_tp as _stp
+
+            n_tp = int(mesh.shape["tp"])
+            for nm, c in (("target", config), ("draft", draft_config)):
+                if c is not None and c.n_head % n_tp:
+                    raise ValueError(
+                        f"{nm} n_head={c.n_head} not divisible by mesh "
+                        f"tp={n_tp} — the pool shards whole heads"
+                    )
+            if n_tp > 1:
+                # Head-aligned qkv shards need the split3 einsum order over
+                # the same (3, D, D) params — the identical switch training
+                # makes when its mesh has tp > 1 (training/train.py).
+                if config.qkv_proj != "split3":
+                    config = dataclasses.replace(config, qkv_proj="split3")
+                if draft_config is not None and draft_config.qkv_proj != "split3":
+                    draft_config = dataclasses.replace(
+                        draft_config, qkv_proj="split3"
+                    )
+            params = _stp.put_sharded(
+                params, _stp.serve_param_specs(params, mesh), mesh
+            )
+            if draft_params is not None:
+                draft_params = _stp.put_sharded(
+                    draft_params, _stp.serve_param_specs(draft_params, mesh), mesh
+                )
         self.config = config
         self.params = params
         self.scheduler = scheduler if scheduler is not None else FCFSScheduler()
@@ -500,6 +560,12 @@ class ServeEngine:
         self.cache = PagedKVCache.init(
             config, num_pages=num_pages, page_size=page_size, dtype=cache_dtype
         )
+        if mesh is not None:
+            from midgpt_tpu.parallel import serve_tp as _stp
+
+            self.cache = _stp.put_sharded(
+                self.cache, _stp.serve_cache_specs(self.cache), mesh
+            )
         # ---- speculative decoding (docs/SERVING.md) ----
         # A draft model turns every decode round into draft-k-then-verify:
         # the draft proposes spec_k tokens against its OWN paged pool, the
@@ -556,6 +622,12 @@ class ServeEngine:
                 dtype=cache_dtype,
             )
         )
+        if mesh is not None and self.draft_cache is not None:
+            from midgpt_tpu.parallel import serve_tp as _stp
+
+            self.draft_cache = _stp.put_sharded(
+                self.draft_cache, _stp.serve_cache_specs(self.draft_cache), mesh
+            )
         # aggregate speculative counters (spec_stats)
         self._spec_rounds = 0
         self._spec_verifies = 0  # (slot, round) pairs
@@ -738,6 +810,38 @@ class ServeEngine:
             "decode": jit_cache_size(_serve_decode_chunk),
             "spec_draft": jit_cache_size(_spec_draft_chunk),
             "spec_verify": jit_cache_size(_spec_verify_chunk),
+        }
+
+    def mesh_shape(self) -> tp.Optional[tp.Dict[str, int]]:
+        """{'data': d, 'tp': t} when mesh-sharded, None single-chip."""
+        from midgpt_tpu.parallel.serve_tp import mesh_shape
+
+        return mesh_shape(self.mesh)
+
+    def cache_hbm_bytes_per_shard(self) -> int:
+        """Per-DEVICE bytes of the target pool. Every pool leaf (K/V pages
+        and int8 scale side buffers) shards its head axis over 'tp' and
+        replicates elsewhere, so a tp shard holds exactly total/tp — the
+        number a per-chip HBM budget must be judged against, and the lever
+        the tp bench reports: slot capacity per chip grows with the mesh
+        (tools/bench_serve.py serve_tp profile)."""
+        n_tp = 1 if self.mesh is None else int(self.mesh.shape["tp"])
+        return self.cache_hbm_bytes() // n_tp
+
+    def stats(self) -> tp.Dict[str, tp.Any]:
+        """Deployment-shape + counter snapshot for SLO reporting: the
+        `serve_slo` JSON lines (tools/loadgen.py) carry this so a sharded
+        run is distinguishable from a single-chip one by its record alone."""
+        return {
+            "mesh": self.mesh_shape(),
+            "cache_hbm_bytes": self.cache_hbm_bytes(),
+            "cache_hbm_bytes_per_shard": self.cache_hbm_bytes_per_shard(),
+            "rounds": self.rounds,
+            "preemptions": self.preemptions,
+            "timeouts": self.timeouts,
+            "shed": self.shed,
+            "cancelled": self.cancelled,
+            "compile_counts": self.compile_stats(),
         }
 
     # -- scheduling round ----------------------------------------------
@@ -1073,6 +1177,7 @@ class ServeEngine:
             n_valid_j,
             self.cache,
             row,
+            self.mesh,
         )
         if self.draft_params is not None and not self.draft_shares_cache:
             # A separate draft model's pool must hold the same positions as
@@ -1089,6 +1194,7 @@ class ServeEngine:
                 n_valid_j,
                 self.draft_cache,
                 row,
+                self.mesh,
             )
         slot.prompt_pos += n_valid
         slot.length = slot.prompt_pos
@@ -1185,6 +1291,7 @@ class ServeEngine:
             self.top_p,
             self.attn_impl,
             key,
+            self.mesh,
         )
         toks = np.asarray(toks)  # (n, B) — forces the dispatch
         t_done = self._clock()
@@ -1282,6 +1389,7 @@ class ServeEngine:
             self.top_p,
             self.attn_impl,
             key_d,
+            self.mesh,
         )
         if shared:
             self.cache = draft_cache_out
@@ -1302,6 +1410,7 @@ class ServeEngine:
             self.top_p,
             self.attn_impl,
             key_v,
+            self.mesh,
         )
         n_accept = np.asarray(n_accept)
         out = np.asarray(out)  # forces both dispatches

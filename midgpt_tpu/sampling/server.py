@@ -283,6 +283,7 @@ class AsyncServeServer:
             "prefilled_tokens": eng.prefilled_tokens,
             "free_pages": eng.allocator.free_count,
             "prefix": eng.prefix_stats(),
+            "mesh": eng.mesh_shape(),
         }
 
     async def drain(self) -> None:

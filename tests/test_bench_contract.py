@@ -15,6 +15,7 @@ from midgpt_tpu.analysis.bench_contract import (
     check_serve_bench,
     check_serve_prefix_bench,
     check_serve_slo_bench,
+    check_serve_tp_bench,
     check_train_bench,
     parse_single_json_line,
 )
@@ -133,6 +134,49 @@ def test_bench_serve_prefix_emits_conformant_json_line(capsys):
         "prefill" in p
         for p in check_serve_prefix_bench(
             dict(rec, prefix_prefill_tokens=rec["baseline_prefill_tokens"] + 1)
+        )
+    )
+
+
+def test_bench_serve_tp_emits_conformant_json_line(capsys):
+    """--tp mode: the serve_tp profile (single-chip vs tensor-parallel
+    engine per cache mode) must hold the one-JSON-line contract with every
+    match_* EXACTLY 1.0 and the per-shard HBM arithmetic exact. Tiny
+    shapes + few quick-train steps — structure check, not a perf claim.
+    Default (17-page) pool geometry: disjoint from the 25/27/31-page
+    geometries the recompile pins count from a pristine baseline."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--tp", "2",
+            "--n-requests", "4",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+            "--train-steps", "8",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_tp")
+    assert not problems, problems
+    assert rec["match_f32"] == rec["match_int8"] == rec["match_spec"] == 1.0
+    assert rec["mesh"] == {"data": 1, "tp": 2}
+    assert rec["cache_hbm_bytes_per_shard"] * 2 == rec["cache_hbm_bytes"]
+    # checker drift behavior on the real record: inexact parity and broken
+    # shard arithmetic are contract violations, not numbers
+    assert any(
+        "match_int8" in p
+        for p in check_serve_tp_bench(dict(rec, match_int8=0.998))
+    )
+    assert any(
+        "per-shard" in p
+        for p in check_serve_tp_bench(
+            dict(rec, cache_hbm_bytes_per_shard=rec["cache_hbm_bytes"])
         )
     )
 

@@ -311,3 +311,15 @@ def test_audit_suite_passes_on_cpu_mesh():
     assert all(n == 0 for n in report["verify_while_bodies"].values())
     assert all(n == 0 for n in report["decode_loop_pool_copies"].values())
     assert all(n == 0 for n in report["verify_loop_pool_copies"].values())
+    # mesh-sharded serving extensions: per-program in-loop collective
+    # census on the tp=2 lowerings — exactly the two megatron activation
+    # all-reduces per layer per step (2*n_layer for the step-scan decode/
+    # draft bodies, 2 for the layer-scan verify body), no other collective
+    # op anywhere in a loop, and zero per-shard pool/scale copies
+    assert report["tp_mesh"] == {"tp": 2, "data": 1}
+    assert report["tp_decode_loop_all_reduces"] == 4
+    assert report["tp_decode_int8_loop_all_reduces"] == 4
+    assert report["tp_verify_loop_all_reduces"] == 2
+    assert report["tp_draft_int8_loop_all_reduces"] == 2
+    for name in ("tp_decode", "tp_decode_int8", "tp_verify", "tp_draft_int8"):
+        assert report[f"{name}_loop_pool_copies"] == 0

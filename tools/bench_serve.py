@@ -211,6 +211,122 @@ def _spec_bench(args, cfg, params, cache_dtype, trace, total_new) -> int:
     return 0
 
 
+def _tp_bench(args, cfg, params, trace, total_new) -> int:
+    """--tp mode: single-chip vs tensor-parallel mesh-sharded engine on the
+    same greedy trace, one pass per cache mode — base dtype, int8, and
+    self-draft speculation ('serve_tp' profile, analysis/bench_contract.py).
+
+    The headline numbers are match_f32/match_int8/match_spec, each required
+    EXACTLY 1.0: the tp engine shards head-aligned einsums whose megatron
+    all-reduce restores the same f32 partials the single chip computes, so
+    sharding must be bit-invisible to the token streams, the invariant
+    tests/test_tp_serving.py pins per mode (the quick fit is belt-and-braces
+    — parity holds on raw init too, but a fitted model makes the match
+    robust to any future near-tie in the argmax). Per-shard HBM
+    is reported because the pool is sharded on the head axis: each of the
+    tp shards holds cache_hbm_bytes / tp, which is THE capacity lever tp
+    serving buys (docs/SERVING.md 'Mesh-sharded serving')."""
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.parallel.serve_tp import make_serve_mesh
+    from midgpt_tpu.sampling.serve import ServeEngine
+    from midgpt_tpu.sampling.spec import self_draft
+
+    n_dev = len(jax.devices())
+    if args.tp < 2 or args.tp > n_dev:
+        raise SystemExit(f"--tp {args.tp} needs 2 <= tp <= {n_dev} devices")
+    if cfg.n_head % args.tp:
+        raise SystemExit(f"--tp {args.tp} must divide n_head {cfg.n_head}")
+    params, final_loss = _quick_train(cfg, params, args.train_steps, args.seed)
+    mesh = make_serve_mesh(tp_size=args.tp)
+    base_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    draft_layers = args.spec_draft_layers or max(1, cfg.n_layer // 3)
+    draft_cfg, draft_params = self_draft(cfg, params, draft_layers)
+
+    def run(mesh_arg, mode):
+        kw = {}
+        if mode == "spec":
+            kw = dict(
+                draft_params=draft_params,
+                draft_config=draft_cfg,
+                draft_shares_cache=True,
+                spec_k_max=args.spec_k,
+            )
+        eng = ServeEngine(
+            cfg,
+            params,
+            max_slots=args.max_slots,
+            page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk,
+            decode_chunk=args.decode_chunk,
+            temperature=0.0,
+            cache_dtype="int8" if mode == "int8" else base_dtype,
+            mesh=mesh_arg,
+            **kw,
+        )
+        uids = [(eng.submit(p, m), len(p)) for p, m in trace]
+        t0 = time.perf_counter()
+        done = eng.run()
+        return eng, done, time.perf_counter() - t0, uids
+
+    fields = {}
+    engines = {}
+    for mode in ("f32", "int8", "spec"):
+        run(None, mode)  # warm the single-chip shapes for this mode
+        _, done_s, dt_s, uids = run(None, mode)
+        run(mesh, mode)  # warm the tp-sharded shapes
+        eng_tp, done_t, dt_t, _ = run(mesh, mode)
+        engines[mode] = eng_tp
+        fields[f"match_{mode}"] = round(
+            _greedy_match_frac(done_s, done_t, uids), 4
+        )
+        fields[f"single_tok_s_{mode}"] = round(total_new / dt_s, 2)
+        fields[f"tp_tok_s_{mode}"] = round(total_new / dt_t, 2)
+
+    eng = engines["f32"]
+    shard = int(eng.cache_hbm_bytes_per_shard())
+    print(
+        json.dumps(
+            {
+                "bench": "serve_tp",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "max_slots": args.max_slots,
+                "page_size": args.page_size,
+                "tp": args.tp,
+                "n_devices": n_dev,
+                "mesh": eng.mesh_shape(),
+                "base_dtype": str(jnp.dtype(base_dtype)),
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "train_steps": args.train_steps,
+                "train_loss": round(final_loss, 3),
+                "draft_layers": draft_layers,
+                "spec_k_max": args.spec_k,
+                **fields,
+                "num_pages": eng.allocator.num_pages,
+                "int8_num_pages": engines["int8"].allocator.num_pages,
+                # head-axis sharding: each shard holds exactly total/tp —
+                # the contract checker re-derives both from the totals
+                "cache_hbm_bytes": int(eng.cache_hbm_bytes()),
+                "cache_hbm_bytes_per_shard": shard,
+                "hbm_per_slot_per_shard_bytes": shard // args.max_slots,
+                "int8_cache_hbm_bytes_per_shard": int(
+                    engines["int8"].cache_hbm_bytes_per_shard()
+                ),
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def _prefix_bench(args, cfg, params, cache_dtype) -> int:
     """--shared-prefix-frac mode: template-heavy workload (N shared system
     prompts x unique tails, plus exact-duplicate resubmissions that
@@ -401,6 +517,15 @@ def main() -> int:
                     "budget ('serve_prefix' JSON profile). 0.8 with "
                     "--n-requests 24 is the acceptance workload "
                     "(docs/SERVING.md 'Prefix cache')")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="> 0 selects the tensor-parallel A/B bench: the "
+                    "same trace through a single-chip engine and a mesh-"
+                    "sharded engine (params via the megatron tp rules, KV "
+                    "pool on the head axis) per cache mode — base dtype, "
+                    "int8, self-draft spec — with every match_* required "
+                    "exactly 1.0 ('serve_tp' JSON profile). Pair with "
+                    "--cpu-devices 8 on this host (docs/SERVING.md "
+                    "'Mesh-sharded serving')")
     ap.add_argument("--prefix-templates", type=int, default=2,
                     help="distinct shared system prompts in the workload")
     ap.add_argument("--template-tokens", type=int, default=0,
@@ -442,7 +567,7 @@ def main() -> int:
     baseline_dtype = jnp.bfloat16 if on_tpu else jnp.float32
     quantized = args.kv_dtype == "int8"
     train_loss = None
-    if quantized and not args.spec and not args.shared_prefix_frac:
+    if quantized and not args.spec and not args.shared_prefix_frac and not args.tp:
         # (the prefix bench skips the fit: its greedy_match_frac compares
         # cache-on vs cache-off at the SAME dtype, which is exact bitwise
         # — no numeric perturbation for training to make meaningful)
@@ -465,6 +590,9 @@ def main() -> int:
         m = int(rng.integers(8, max(9, min(64, S - t0))))
         trace.append((rng.integers(0, cfg.vocab_size, t0, dtype=np.int64), m))
     total_new = sum(m for _, m in trace)
+
+    if args.tp:
+        return _tp_bench(args, cfg, params, trace, total_new)
 
     if args.spec:
         return _spec_bench(args, cfg, params, cache_dtype, trace, total_new)

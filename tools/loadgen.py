@@ -263,6 +263,13 @@ def main() -> int:
     ap.add_argument("--n-embd", type=int, default=32)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force CPU with this many virtual devices (0 = native)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="> 0 runs every engine tensor-parallel on a "
+                    "(data=1, tp=N) serve mesh (parallel/serve_tp.py): "
+                    "params sharded by the megatron tp rules, KV pool on "
+                    "the head axis. The serve_slo line carries tp/mesh "
+                    "fields so sharded and single-chip curves are "
+                    "distinguishable. Pair with --cpu-devices >= N")
     args = ap.parse_args()
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
 
@@ -292,6 +299,18 @@ def main() -> int:
     on_tpu = jax.default_backend() == "tpu"
     cache_dtype = jnp.bfloat16 if on_tpu else jnp.float32
 
+    mesh = None
+    if args.tp:
+        from midgpt_tpu.parallel.serve_tp import make_serve_mesh
+
+        if args.tp < 2 or args.tp > len(jax.devices()):
+            raise SystemExit(
+                f"--tp {args.tp} needs 2 <= tp <= {len(jax.devices())} devices"
+            )
+        if cfg.n_head % args.tp:
+            raise SystemExit(f"--tp {args.tp} must divide n_head {cfg.n_head}")
+        mesh = make_serve_mesh(tp_size=args.tp)
+
     def make_engine():
         sched = (
             SLOScheduler(min_headroom_s=args.min_headroom_s)
@@ -311,6 +330,7 @@ def main() -> int:
             max_backlog_pages=args.max_backlog_pages or None,
             scheduler=sched,
             prefix_cache=bool(args.prefix_cache),
+            mesh=mesh,
         )
 
     # Warm EVERY (decode-chunk tail x page bucket) program the workload
@@ -396,6 +416,11 @@ def main() -> int:
                 },
                 "max_slots": args.max_slots,
                 "num_pages": args.num_pages,
+                # sharding provenance: serve_slo lines from a tp-sharded
+                # engine must not be comparable-by-accident with
+                # single-chip curves (ServeEngine.stats() carries the same)
+                "tp": args.tp or None,
+                "mesh": warm.mesh_shape(),
                 "max_backlog_pages": args.max_backlog_pages or None,
                 "points": points,
                 # hottest-point headline numbers (driver contract fields)
