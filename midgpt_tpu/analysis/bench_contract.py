@@ -319,6 +319,85 @@ def check_serve_tp_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_longctx_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --long-ctx profile: split-K decode A/B (field
+    table: docs/SERVING.md 'Split-K decode'). Two load-bearing invariants:
+
+      * greedy_match_frac == 1.0 EXACTLY — split-K reorders f32 softmax
+        reductions, so the bench pins that on a fitted model the argmax
+        margins absorb the reorder (tests/test_split_k.py pins the same
+        matrix per cache mode); any mismatch is a kernel bug or a model
+        with no margins, either of which invalidates the record.
+      * split_k_short == 1 — the no-regression-at-short-T guarantee is
+        structural: the auto bucket rule must keep short traffic on the
+        byte-identical unsplit program. The forced-split short latency
+        (short_ratio) is recorded as diagnostic context, not gated — on
+        tiny CPU-mesh rounds it is dominated by per-dispatch overhead.
+
+    split_k_long >= 2 and t_long >= 1024 keep the record an actual A/B:
+    an unsplit-vs-unsplit run would vacuously 'match'."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "t_long": (int,),
+            "t_short": (int,),
+            "page_size": (int,),
+            "decode_chunk": (int,),
+            "rounds": (int,),
+            "kv_dtype": (str,),
+            "model": (dict,),
+            "split_k_long": (int,),
+            "split_k_short": (int,),
+            "ms_round_long_unsplit": Number,
+            "ms_round_long_split": Number,
+            "long_speedup": Number,
+            "ms_round_short_unsplit": Number,
+            "ms_round_short_forced_split": Number,
+            "short_ratio": Number,
+            "match_block_size": (int,),
+            "greedy_match_frac": Number,
+            "train_steps": (int,),
+            "train_loss": Number,
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_longctx":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_longctx'"
+        )
+    tl = rec.get("t_long")
+    if isinstance(tl, int) and tl < 1024:
+        problems.append(f"t_long {tl} < 1024 — below the auto-split regime")
+    sl = rec.get("split_k_long")
+    if isinstance(sl, int) and sl < 2:
+        problems.append(
+            f"split_k_long {sl} < 2 — the long point never engaged split-K, "
+            "so the A/B is vacuous"
+        )
+    ss = rec.get("split_k_short")
+    if isinstance(ss, int) and ss != 1:
+        problems.append(
+            f"split_k_short {ss} != 1 — short traffic must stay on the "
+            "unsplit program (the structural no-regression guarantee)"
+        )
+    gmf = rec.get("greedy_match_frac")
+    if isinstance(gmf, Number) and gmf != 1.0:
+        problems.append(
+            f"greedy_match_frac {gmf} != 1.0 — split-K must be invisible "
+            "to greedy streams"
+        )
+    for key in ("ms_round_long_unsplit", "ms_round_long_split",
+                "ms_round_short_unsplit", "ms_round_short_forced_split"):
+        v = rec.get(key)
+        if isinstance(v, Number) and v <= 0:
+            problems.append(f"{key} {v} <= 0")
+    return problems
+
+
 def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     """tools/loadgen.py profile: TTFT/TPOT percentiles + shed fraction
     under a seeded arrival process, at >= 2 offered-load points (one point
@@ -428,6 +507,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "serve_spec": check_serve_spec_bench,
     "serve_prefix": check_serve_prefix_bench,
     "serve_tp": check_serve_tp_bench,
+    "serve_longctx": check_serve_longctx_bench,
     "serve_slo": check_serve_slo_bench,
     "graftcheck": check_graftcheck,
 }

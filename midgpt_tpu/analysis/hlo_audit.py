@@ -427,6 +427,115 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             )
 
     # ------------------------------------------------------------------
+    # split-K lowerings: partitioning must add zero pool traffic
+    # ------------------------------------------------------------------
+    # split_k > 1 partitions the attention softmax statistics over key
+    # partitions (kernels/decode_attention.py gather paths; the Pallas
+    # template's extra grid dimension on TPU). The audit claim: the split
+    # lowering reads the pool through the same single gather as the
+    # unsplit pass — it must not copy the pool (or, int8, the scale side
+    # buffers) inside the decode loop, and it introduces no collectives
+    # (the partial merge is per-slot elementwise math). Censused on the
+    # same three serving programs as the unsplit audits, at split_k=4.
+    split4_decode_hlo = (
+        _serve_decode_chunk.lower(
+            mc,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            4,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+            None,
+            4,
+        )
+        .compile()
+        .as_text()
+    )
+    assert_no_while_body_collectives(split4_decode_hlo)
+    s_census = while_body_collectives(split4_decode_hlo)
+    report["split_decode_while_bodies"] = {b: len(ls) for b, ls in s_census.items()}
+    assert s_census, "split-K decode lowered without its while loops"
+    s_copies = while_body_pool_copies(split4_decode_hlo, pool_shape)
+    report["split_decode_loop_pool_copies"] = {
+        b: len(ls) for b, ls in s_copies.items()
+    }
+    assert all(not ls for ls in s_copies.values()), (
+        "pool-sized copies inside the split-K decode loops: "
+        + str({b: ls[:1] for b, ls in s_copies.items() if ls})
+    )
+
+    split4_verify_hlo = (
+        _spec_verify_chunk.lower(
+            mc_scan,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((K, B), jnp.int32),
+            jax.ShapeDtypeStruct((K, B, mc.vocab_size), jnp.float32),
+            cache_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+            None,
+            4,
+        )
+        .compile()
+        .as_text()
+    )
+    assert_no_while_body_collectives(split4_verify_hlo)
+    sv_copies = while_body_pool_copies(split4_verify_hlo, pool_shape)
+    report["split_verify_loop_pool_copies"] = {
+        b: len(ls) for b, ls in sv_copies.items()
+    }
+    assert all(not ls for ls in sv_copies.values()), (
+        "pool-sized copies inside the split-K verify loops: "
+        + str({b: ls[:1] for b, ls in sv_copies.items() if ls})
+    )
+
+    split4_decode8_hlo = (
+        _serve_decode_chunk.lower(
+            mc,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache8_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            4,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+            None,
+            4,
+        )
+        .compile()
+        .as_text()
+    )
+    assert_no_while_body_collectives(split4_decode8_hlo)
+    for label, shape in (("pool", pool8_shape), ("scale", scale_shape)):
+        copies = while_body_pool_copies(split4_decode8_hlo, shape)
+        report[f"split_decode_int8_loop_{label}_copies"] = {
+            b: len(ls) for b, ls in copies.items()
+        }
+        assert all(not ls for ls in copies.values()), (
+            f"{label}-sized copies inside the split-K int8 decode loops: "
+            + str({b: ls[:1] for b, ls in copies.items() if ls})
+        )
+
+    # ------------------------------------------------------------------
     # tp serving mesh: per-program in-loop collective census
     # ------------------------------------------------------------------
     # The mesh-sharded engine's perf claim (docs/SERVING.md "Mesh-sharded
@@ -474,16 +583,22 @@ def run_audit() -> tp.Dict[str, tp.Any]:
         sds = jax.ShapeDtypeStruct
         i32, b1 = jnp.int32, jnp.bool_
 
-        def _decode_lower(cfg, cache):
+        def _decode_lower(cfg, cache, split_k=1):
             return _serve_decode_chunk.lower(
                 cfg, params_tp, sds((B,), i32), cache,
                 sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
-                4, 0.0, None, None, "gather", None, smesh,
+                4, 0.0, None, None, "gather", None, smesh, split_k,
             ).compile().as_text()
 
         tp_programs = {
             "tp_decode": (_decode_lower(mc3, cache_tp), 2 * mc.n_layer),
             "tp_decode_int8": (_decode_lower(mc3, cache8_tp), 2 * mc.n_layer),
+            # split-K under tp: the partition scan rides INSIDE each head
+            # shard — the all-reduce budget must not move by a single op
+            "tp_decode_split": (
+                _decode_lower(mc3, cache_tp, split_k=4),
+                2 * mc.n_layer,
+            ),
             "tp_verify": (
                 _spec_verify_chunk.lower(
                     mc3_scan, params_tp, sds((B,), i32), sds((K, B), i32),

@@ -1,0 +1,263 @@
+"""Unified paged-attention Pallas kernel TEMPLATE.
+
+One parameterized kernel body serves every paged-attention variant the
+serving engine compiles, where kernels/decode_attention.py previously
+hand-wrote a skeleton per variant (plain decode and multi-row verify, each
+duplicating the page translation, the online-softmax sweep, and the int8
+dequant read path). The template's axes of variation are *specs*, not new
+kernels:
+
+  * `n_rows` — query rows per slot: 1 for plain decode, k+1 for
+    speculative verify (each row masks to its own visible-key count);
+  * `quantized` — bf16/f32 direct reads vs int8 pages with fused in-VMEM
+    f32-scale dequant (one (1, H, page_size) scale row per page, riding
+    the same scalar-prefetched page translation as its page);
+  * `split_k` — 1 emits the finalized output in-kernel (the classic
+    sweep); s > 1 partitions the visible key sequence across a second
+    parallel grid dimension, each partition sweeping max_pages/s pages and
+    emitting RAW (m, l, acc) online-softmax partials that are merged
+    outside the kernel with ops/online_softmax.merge_partials — the
+    FlashAttention-2-style work partitioning that keeps the chip busy when
+    a single long request is the whole batch.
+
+Skeleton (shared by every mode):
+
+  grid (B, split_k, pages_per_split), pages innermost/sequential. The page
+  table and per-row counts ride PrefetchScalarGridSpec scalar prefetch, so
+  the K/V BlockSpec index maps translate (slot, partition, logical page)
+  -> physical page BEFORE the DMA is issued. Online-softmax running
+  statistics (ops/online_softmax.online_block) live in VMEM scratch across
+  each partition's page sweep; pages past the slot's last visible key are
+  predicated off with pl.when (no lax.cond anywhere — graftcheck GC001).
+
+Split-K partial buffers fold the partition axis into the slot axis
+((B*split_k, H, R, C) f32 acc + (B*split_k, H, R, 8) stats) so every
+block's last two dims either span the full array dim or are the 8-lane
+statistics tile — Mosaic-tileable with no 5-D layouts. The merge is
+per-(slot, head, row) elementwise math: under a tensor-parallel shard_map
+it runs inside each head shard with ZERO new collectives.
+
+A new attention variant (GQA, sliding window) is a new spec over this
+template: a different q BlockSpec or column-mask expression, not a fourth
+hand-written sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from midgpt_tpu.kernels.flash_attention import _STATS_LANES, _interpret
+from midgpt_tpu.ops.online_softmax import (
+    M_INIT,
+    MASK,
+    finalize,
+    merge_partials,
+    online_block,
+)
+
+Array = jax.Array
+
+
+def normalize_split_k(split_k: int, max_pages: int) -> int:
+    """Largest pow2 <= split_k that divides the page-table width.
+
+    Serving page buckets are pow2 (or the pow2-capped max), so any pow2
+    split <= max_pages divides it; the loop is the general-case guard for
+    direct kernel callers with odd table widths."""
+    s = max(1, int(split_k))
+    s = min(s, max_pages)
+    s = 1 << (s.bit_length() - 1)  # pow2 floor (applied after the clamp)
+    while max_pages % s:
+        s //= 2
+    return s
+
+
+def _tpl_kernel(
+    pt_ref,  # (B, max_pages) int32 scalar-prefetch: page table
+    cnt_ref,  # (B, R) int32 scalar-prefetch: visible keys per row
+    q_ref,  # (1, H, R, C) — head-major rows
+    k_ref,  # (H, 1, page_size, C)
+    v_ref,  # (H, 1, page_size, C)
+    *rest,  # int8 mode: ks_ref, vs_ref (1, H, page_size) f32; then outputs
+    # split_k == 1: o_ref (1, H, R, C)
+    # split_k > 1:  o_ref (1, H, R, C) f32, m_ref/l_ref (1, H, R, 8) f32
+    # then scratch: acc_sc (H, R, C) f32, m_sc/l_sc (H, R, 8) f32
+    scale: float,
+    page_size: int,
+    n_rows: int,
+    split_k: int,
+    pages_per_split: int,
+    quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, *outs = rest
+    else:
+        outs = rest
+    if split_k > 1:
+        o_ref, m_ref, l_ref, acc_sc, m_sc, l_sc = outs
+    else:
+        o_ref, acc_sc, m_sc, l_sc = outs
+    b, si, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, M_INIT)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # Per-row counts from SMEM, assembled by a static unroll over the
+    # (small, static) row count. Counts are nondecreasing in the row index
+    # (verify rows see lengths + t + 1 keys), so the last row's count
+    # bounds the page sweep for the whole tile.
+    counts = jnp.stack([cnt_ref[b, t] for t in range(n_rows)])  # (R,)
+    page0 = (si * pages_per_split + p) * page_size
+
+    @pl.when(page0 < cnt_ref[b, n_rows - 1])
+    def _compute():
+        q = q_ref[0]  # (H, R, C)
+        k = k_ref[:, 0]  # (H, page_size, C)
+        if quantized:
+            # Dequantize in VMEM: the page's f32 scales broadcast over C
+            # (exact — int8 * f32, ops/quant.py), then the same dots as
+            # the bf16 path in f32.
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (H, R, page_size) f32
+        col = page0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(col < counts[None, :, None], s, MASK)
+
+        m_new, alpha, prob, l_new = online_block(m_sc[:, :, 0], l_sc[:, :, 0], s)
+        if quantized:
+            v = v_ref[:, 0].astype(jnp.float32) * vs_ref[0][:, :, None]
+        else:
+            v = v_ref[:, 0]
+        pv = jax.lax.dot_general(
+            prob.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (H, R, C)
+        acc_sc[:] = acc_sc[:] * alpha[:, :, None] + pv
+        m_sc[:] = jnp.broadcast_to(m_new[:, :, None], m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new[:, :, None], l_sc.shape)
+
+    @pl.when(p == pages_per_split - 1)
+    def _emit():
+        if split_k > 1:
+            # Raw partials out; merge_partials + finalize run outside.
+            o_ref[0] = acc_sc[:]
+            m_ref[0] = m_sc[:]
+            l_ref[0] = l_sc[:]
+        else:
+            out, _ = finalize(m_sc[:, :, 0], l_sc[:, :, 0], acc_sc[:])
+            o_ref[0] = out.astype(o_ref.dtype)
+
+
+def paged_attention_template(
+    q: Array,  # (B, H, R, C) — head-major query rows
+    k_pages: Array,  # (H, num_pages, page_size, C) — ONE layer's pool
+    v_pages: Array,
+    page_table: Array,  # (B, max_pages) int32
+    counts: Array,  # (B, R) int32 — keys visible to row r of slot b
+    k_scale: tp.Optional[Array] = None,  # (num_pages, H, page_size) f32
+    v_scale: tp.Optional[Array] = None,
+    split_k: int = 1,
+) -> Array:
+    """Instantiate the template for one (n_rows, quantized, split_k) spec.
+
+    Returns (B, H, R, C) in q.dtype. int8 pools require both scale side
+    buffers; bf16/f32 pools take none. split_k is normalized to a pow2
+    divisor of the table width; split_k == 1 is the classic in-kernel
+    finalize, split_k > 1 emits per-partition partials and merges them
+    here (f32, ops/online_softmax) before the final dtype cast."""
+    B, H, R, C = q.shape
+    _, _, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    split_k = normalize_split_k(split_k, max_pages)
+    pps = max_pages // split_k
+    scale = 1.0 / math.sqrt(C)
+    quantized = k_scale is not None
+
+    page_spec = pl.BlockSpec(
+        (H, 1, page_size, C),
+        lambda b, si, p, pt, cnt: (0, pt[b, si * pps + p], 0, 0),
+    )
+    in_specs = [
+        pl.BlockSpec((1, H, R, C), lambda b, si, p, pt, cnt: (b, 0, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # One page's scales per grid step, translated through the same
+        # scalar-prefetched table as its page. Trailing dims (H, page_size)
+        # span the full array dims -> Mosaic-tileable as-is.
+        scale_spec = pl.BlockSpec(
+            (1, H, page_size),
+            lambda b, si, p, pt, cnt: (pt[b, si * pps + p], 0, 0),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
+    if split_k > 1:
+        # Partition axis folded into the slot axis: 4-D partial buffers
+        # whose trailing block dims span the full array dims (Mosaic rule).
+        part_idx = lambda b, si, p, pt, cnt: (b * split_k + si, 0, 0, 0)
+        out_specs = [
+            pl.BlockSpec((1, H, R, C), part_idx),
+            pl.BlockSpec((1, H, R, _STATS_LANES), part_idx),
+            pl.BlockSpec((1, H, R, _STATS_LANES), part_idx),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((B * split_k, H, R, C), jnp.float32),
+            jax.ShapeDtypeStruct((B * split_k, H, R, _STATS_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B * split_k, H, R, _STATS_LANES), jnp.float32),
+        ]
+    else:
+        out_specs = pl.BlockSpec(
+            (1, H, R, C), lambda b, si, p, pt, cnt: (b, 0, 0, 0)
+        )
+        out_shape = jax.ShapeDtypeStruct((B, H, R, C), q.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, split_k, pps),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((H, R, C), jnp.float32),
+            pltpu.VMEM((H, R, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((H, R, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _tpl_kernel, scale=scale, page_size=page_size, n_rows=R,
+            split_k=split_k, pages_per_split=pps, quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            # slots and partitions are independent; the page sweep is the
+            # sequential reduction (scratch carries across it)
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), counts.astype(jnp.int32), *operands)
+    if split_k == 1:
+        return out
+    o, m, l = out
+    o = o.reshape(B, split_k, H, R, C)
+    m = m.reshape(B, split_k, H, R, _STATS_LANES)[..., 0]
+    l = l.reshape(B, split_k, H, R, _STATS_LANES)[..., 0]
+    m, l, acc = merge_partials(m, l, o, axis=1)
+    merged, _ = finalize(m, l, acc)
+    return merged.astype(q.dtype)

@@ -1,5 +1,6 @@
-"""Pallas TPU paged decode/verify attention for the continuous-batching
-engine, in bf16 and int8-quantized cache modes.
+"""Paged decode/verify attention for the continuous-batching engine, in
+bf16 and int8-quantized cache modes, with optional split-K sequence
+partitioning.
 
 Decode-time attention reads K/V through a per-slot PAGE TABLE instead of a
 contiguous (B, S, ...) cache: physical pages of `page_size` tokens live in a
@@ -10,141 +11,56 @@ lengths — the two levers the serving layer needs (vLLM-style paged memory +
 FlashAttention-style work partitioning, PAPERS.md) under XLA's static-shape
 constraint.
 
-Kernel structure: grid (B, max_pages), pages innermost/sequential. The page
-table and per-slot lengths ride `PrefetchScalarGridSpec` scalar prefetch, so
-the K/V BlockSpec index maps translate (slot, logical page) -> physical page
-BEFORE the DMA is issued: each grid step pulls exactly one (page_size, C)
-page per head into VMEM — never the whole pool. Online-softmax running
-statistics live in VMEM scratch across the page sweep (same scheme as
-kernels/flash_attention.py, whose finite MASK/M_INIT constants this reuses).
-Pages at or past a slot's length are predicated off with `pl.when` (compute
-skipped; the block DMA still runs — it reads the reserved sink page or a
-stale page, both masked).
-
-**Int8 mode** (PagedKVCache int8 storage): pages arrive int8 with f32
-absmax scales in (num_pages, H, page_size) side buffers (one scale per K/V
-vector per head, ops/quant.py). The scale BlockSpec (1, H, page_size)
-fetches exactly one page's scales alongside its int8 page — the trailing
-block dims span the full (H, page_size) array dims, so the layout is
-Mosaic-tileable with no in-kernel transpose — and dequantization happens in
-VMEM before QK^T/PV: HBM only ever moves int8 pages plus the tiny scale
-rows, which is the whole point (decode is HBM-bandwidth-bound; halving
-cache bytes ~halves decode-attention traffic).
-
-There are TWO kernels:
-
-  * `paged_attention_kernel` — one query row per slot (plain decode).
-  * `paged_verify_attention_kernel` — T = k+1 query rows per slot with a
-    per-row visible-key count (speculative verification,
-    GPT.verify_step_paged): the multi-row sibling with (H, T, page_size)
-    score tiles and per-(head, row) online-softmax stats. This replaces
-    the gather lowering as the compiled verify path on TPU (it was the
-    named upgrade path of the speculative-decoding PR).
-
-Blocks obey the Mosaic tiling rule (CLAUDE.md): every block's last two
-dims are (8, 128)-divisible or span the full array dim.
+Both compiled variants — plain decode (one query row per slot) and
+multi-row speculative verify (T = k+1 rows with per-row visible-key
+counts, GPT.verify_step_paged) — are instantiations of ONE parameterized
+kernel (kernels/attention_template.py): shared scalar-prefetched page
+translation, shared online-softmax sweep (ops/online_softmax.py), shared
+int8 fused-dequant read path. `split_k > 1` additionally partitions each
+slot's visible key sequence over a parallel grid dimension — per-partition
+raw (m, l, acc) partials merged outside the kernel — which is what keeps
+the chip busy when a single long request is the whole batch (the T>=4k
+single-slot regime; docs/SERVING.md "Split-K decode").
 
 Off-TPU the dispatchers use the XLA gather fallbacks below, which mirror
 the contiguous `GPT.decode_step` attention op-for-op (same einsum shapes,
 same mask-then-scale-then-f32-softmax order, dequantizing right after the
 page gather in int8 mode) so paged decode stays token-exact with the
-single-request engine on the CPU test mesh; the kernels themselves run in
-interpret mode only under their parity tests (tests/test_decode_attention.py
+single-request engine on the CPU test mesh. The split-K gather sibling
+keeps the unsplit pass's fat q.K score matmul and partitions only the
+softmax STATISTICS: scores reshape into split_k independent partitions,
+one online-softmax block sweeps each, and partials merge with the SAME
+ops/online_softmax.merge_partials math as the kernel path. Deliberately so:
+a host core executes partitions sequentially either way, so the gather
+split lowering aims for structure-neutrality (measured within noise of the
+unsplit pass, RESULTS.md §5) while the kernel's parallel grid dimension
+carries the actual long-T win on hardware (tools/bench_serve.py
+--long-ctx). The kernels themselves run in interpret mode only under
+their parity tests (tests/test_decode_attention.py, tests/test_split_k.py
 and tests/test_quant_cache.py — interpret is too slow for the serving
 tests' inner loop).
 """
 
 from __future__ import annotations
 
-import functools
 import math
 import typing as tp
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from jax.sharding import Mesh, PartitionSpec as P
 
-from midgpt_tpu.kernels.flash_attention import M_INIT, MASK, _interpret
+from midgpt_tpu.kernels.attention_template import (
+    normalize_split_k,
+    paged_attention_template,
+)
+from midgpt_tpu.kernels.flash_attention import M_INIT, MASK
+from midgpt_tpu.ops.online_softmax import finalize, merge_partials, online_block
 from midgpt_tpu.ops.quant import dequantize_q8
 from midgpt_tpu.utils.compat import shard_map
 
 Array = jax.Array
-
-# lane width of the m/l statistics scratch (see flash_attention._STATS_LANES)
-_STATS_LANES = 8
-
-
-def _decode_kernel(
-    pt_ref,  # (B, max_pages) int32 scalar-prefetch: page table
-    len_ref,  # (B,) int32 scalar-prefetch: per-slot valid lengths
-    q_ref,  # (1, H, C)
-    k_ref,  # (H, 1, page_size, C)
-    v_ref,  # (H, 1, page_size, C)
-    *rest,  # int8 mode: ks_ref, vs_ref (1, H, page_size) f32; then
-    # o_ref (1, H, C), acc_sc (H, C) f32, m_sc/l_sc (H, _STATS_LANES) f32
-    scale: float,
-    page_size: int,
-    quantized: bool,
-):
-    if quantized:
-        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
-    else:
-        o_ref, acc_sc, m_sc, l_sc = rest
-    b, p = pl.program_id(0), pl.program_id(1)
-    n_p = pl.num_programs(1)
-
-    @pl.when(p == 0)
-    def _init():
-        acc_sc[:] = jnp.zeros_like(acc_sc)
-        m_sc[:] = jnp.full_like(m_sc, M_INIT)
-        l_sc[:] = jnp.zeros_like(l_sc)
-
-    length = len_ref[b]
-
-    @pl.when(p * page_size < length)
-    def _compute():
-        q = q_ref[0]  # (H, C)
-        k = k_ref[:, 0]  # (H, page_size, C)
-        if quantized:
-            # Dequantize in VMEM: the page's f32 scales broadcast over C
-            # (exact — int8 * f32, ops/quant.py), then the same dots as
-            # the bf16 path in f32.
-            q = q.astype(jnp.float32)
-            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (H, page_size) f32
-        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col < length, s, MASK)
-
-        m_prev = m_sc[:, 0]  # (H,)
-        l_prev = l_sc[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        prob = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
-        if quantized:
-            v = v_ref[:, 0].astype(jnp.float32) * vs_ref[0][:, :, None]
-        else:
-            v = v_ref[:, 0]
-        l_new = l_prev * alpha + jnp.sum(prob, axis=-1)
-        pv = jax.lax.dot_general(
-            prob.astype(v.dtype), v,
-            (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )  # (H, C)
-        acc_sc[:] = acc_sc[:] * alpha[:, None] + pv
-        m_sc[:] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
-
-    @pl.when(p == n_p - 1)
-    def _finalize():
-        l = l_sc[:, 0]
-        safe_l = jnp.maximum(l, 1e-30)  # length-0 slots emit 0, not NaN
-        o_ref[0] = (acc_sc[:] / safe_l[:, None]).astype(o_ref.dtype)
 
 
 def paged_attention_kernel(
@@ -155,57 +71,19 @@ def paged_attention_kernel(
     lengths: Array,  # (B,) int32 — valid tokens per slot (0 = inactive)
     k_scale: tp.Optional[Array] = None,  # (num_pages, H, page_size) f32
     v_scale: tp.Optional[Array] = None,
+    split_k: int = 1,
 ) -> Array:
-    """Paged decode attention via the Pallas kernel. Returns (B, H, C).
-    int8 pools require both scale side buffers; bf16 pools take none."""
-    B, H, C = q.shape
-    _, _, page_size, _ = k_pages.shape
-    max_pages = page_table.shape[1]
-    scale = 1.0 / math.sqrt(C)
-    quantized = k_scale is not None
-
-    page_spec = pl.BlockSpec(
-        (H, 1, page_size, C), lambda b, p, pt, ln: (0, pt[b, p], 0, 0)
+    """Paged decode attention via the kernel template. Returns (B, H, C).
+    int8 pools require both scale side buffers; bf16 pools take none.
+    Plain decode is the template's n_rows == 1 spec: the per-row count IS
+    the slot length."""
+    out = paged_attention_template(
+        q[:, :, None, :],  # (B, H, 1, C)
+        k_pages, v_pages, page_table,
+        lengths[:, None],  # (B, 1) counts
+        k_scale, v_scale, split_k=split_k,
     )
-    in_specs = [
-        pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
-        page_spec,
-        page_spec,
-    ]
-    operands = [q, k_pages, v_pages]
-    if quantized:
-        # One page's scales per grid step, translated through the same
-        # scalar-prefetched table as its page. Trailing dims (H, page_size)
-        # span the full array dims -> Mosaic-tileable as-is.
-        scale_spec = pl.BlockSpec(
-            (1, H, page_size), lambda b, p, pt, ln: (pt[b, p], 0, 0)
-        )
-        in_specs += [scale_spec, scale_spec]
-        operands += [k_scale, v_scale]
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, max_pages),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, H, C), lambda b, p, pt, ln: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, C), jnp.float32),
-            pltpu.VMEM((H, _STATS_LANES), jnp.float32),
-            pltpu.VMEM((H, _STATS_LANES), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        functools.partial(
-            _decode_kernel, scale=scale, page_size=page_size,
-            quantized=quantized,
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, C), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
-        ),
-        interpret=_interpret(),
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
+    return out[:, :, 0, :]
 
 
 def _gather_pages(
@@ -239,24 +117,63 @@ def paged_attention_gather(
     lengths: Array,  # (B,) int32
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
+    split_k: int = 1,
 ) -> Array:
     """XLA fallback: gather each slot's pages contiguous (dequantized in
     int8 mode), then run the exact attention ops of the contiguous
     `GPT.decode_step` (same einsum shapes, -inf mask BEFORE the
     1/sqrt(C)-scaled f32 softmax) so paged and contiguous decode agree
-    token-for-token on CPU. O(B * max_pages) page reads per call — the
-    kernel above is the O(used-length) path on TPU."""
+    token-for-token on CPU.
+
+    split_k == 1 is that classic single pass, byte-for-byte unchanged.
+    split_k > 1 keeps the SAME fat q.K score matmul and partitions only
+    the softmax statistics: the masked f32 scores reshape into split_k
+    independent partitions, one online-softmax block sweeps each, and
+    partials merge with the same ops/online_softmax.merge_partials the
+    kernel path uses — gather and kernel split lowerings share their
+    merge math exactly. No scan, and no partitioned score matmul either:
+    on a single host core a sequential partition loop only adds loop
+    overhead and a partition-shaped dot defeats XLA's fusion of the long
+    masked-softmax axis (both measured, RESULTS.md §5 — the parallel win
+    belongs to the kernel's grid dimension on real hardware), while the
+    stats-only split is within noise of the unsplit pass; greedy decode
+    streams stay token-identical to it (tests/test_split_k.py)."""
     B, H, C = q.shape
-    S = page_table.shape[1] * k_pages.shape[2]
+    page_size = k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    S = max_pages * page_size
+    split_k = normalize_split_k(split_k, max_pages)
+    if split_k == 1:
+        kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
+        vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+        scores = jnp.einsum("bhqc,bhkc->bhqk", q[:, :, None], kg)  # (B, H, 1, S)
+        valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
+        scores = jnp.where(valid, scores, float("-inf"))
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+        ).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkc->bhqc", probs, vg)[:, :, 0]
+
+    part_len = (max_pages // split_k) * page_size
+    scale = 1.0 / math.sqrt(C)
     kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
     vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
-    scores = jnp.einsum("bhqc,bhkc->bhqk", q[:, :, None], kg)  # (B, H, 1, S)
-    valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
-    scores = jnp.where(valid, scores, float("-inf"))
-    probs = jax.nn.softmax(
-        scores.astype(jnp.float32) / math.sqrt(C), axis=-1
-    ).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkc->bhqc", probs, vg)[:, :, 0]
+    s = jnp.einsum("bhc,bhkc->bhk", q, kg).astype(jnp.float32) * scale
+    s = jnp.where(jnp.arange(S)[None, None] < lengths[:, None, None], s, MASK)
+    # Fat dot above, partitioned statistics below: scores reshape into
+    # split_k independent partitions, each swept by one online block from
+    # the init stats — exactly the kernel's single-block partition sweep.
+    s = s.reshape(B, H, split_k, part_len)
+    m = jnp.full((B, H, split_k), M_INIT, jnp.float32)
+    l = jnp.zeros((B, H, split_k), jnp.float32)
+    m, _, p, l = online_block(m, l, s)
+    acc = jnp.einsum(
+        "bhsk,bhskc->bhsc", p.astype(vg.dtype),
+        vg.reshape(B, H, split_k, part_len, C),
+    ).astype(jnp.float32)
+    m, l, acc = merge_partials(m, l, acc, axis=2)
+    out, _ = finalize(m, l, acc, dtype=q.dtype)
+    return out
 
 
 def _tp_shard_map(fn, mesh: Mesh, in_specs, out_specs):
@@ -287,6 +204,7 @@ def paged_attention(
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
     mesh: tp.Optional[Mesh] = None,
+    split_k: int = 1,
 ) -> Array:
     """Dispatch: Pallas kernel on TPU, XLA gather elsewhere (interpret mode
     is orders of magnitude too slow for the serving loop — same policy as
@@ -296,9 +214,11 @@ def paged_attention(
     full-manual shard_map: each tp shard holds H/tp heads of q and of the
     page pool (+ int8 scale rows), the page table and lengths ride in
     replicated, and the per-head online-softmax sweep needs no collective at
-    all — the head axis is embarrassingly parallel. The gather lowering
-    ignores `mesh`: it is plain jnp, and GSPMD partitions it from the
-    operand shardings alone."""
+    all — the head axis is embarrassingly parallel. split_k rides the grid
+    (kernel) or the batched partition axis (gather) INSIDE each head shard, so
+    tensor parallelism and split-K compose with zero new collectives. The
+    gather lowering ignores `mesh`: it is plain jnp, and GSPMD partitions
+    it from the operand shardings alone."""
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
@@ -311,16 +231,18 @@ def paged_attention(
                 in_specs += [P(None, "tp", None)] * 2  # (pages, H, page_size)
                 args += [k_scale, v_scale]
             fn = _tp_shard_map(
-                lambda *a: paged_attention_kernel(*a),
+                lambda *a: paged_attention_kernel(*a, split_k=split_k),
                 mesh, tuple(in_specs), P(None, "tp", None),
             )
             return fn(*args)
         return paged_attention_kernel(
-            q, k_pages, v_pages, page_table, lengths, k_scale, v_scale
+            q, k_pages, v_pages, page_table, lengths, k_scale, v_scale,
+            split_k=split_k,
         )
     if impl == "gather":
         return paged_attention_gather(
-            q, k_pages, v_pages, page_table, lengths, k_scale, v_scale
+            q, k_pages, v_pages, page_table, lengths, k_scale, v_scale,
+            split_k=split_k,
         )
     raise ValueError(f"unknown paged attention impl {impl!r}")
 
@@ -328,84 +250,6 @@ def paged_attention(
 # ----------------------------------------------------------------------
 # Multi-row paged verify attention (speculative decoding)
 # ----------------------------------------------------------------------
-
-
-def _verify_kernel(
-    pt_ref,  # (B, max_pages) int32 scalar-prefetch: page table
-    cnt_ref,  # (B, T) int32 scalar-prefetch: visible keys per row
-    q_ref,  # (1, H, T, C) — head-major (transposed once outside)
-    k_ref,  # (H, 1, page_size, C)
-    v_ref,  # (H, 1, page_size, C)
-    *rest,  # int8 mode: ks_ref, vs_ref (1, H, page_size) f32; then
-    # o_ref (1, H, T, C), acc_sc (H, T, C) f32,
-    # m_sc/l_sc (H, T, _STATS_LANES) f32
-    scale: float,
-    page_size: int,
-    n_rows: int,
-    quantized: bool,
-):
-    """The decode kernel's online-softmax page sweep, widened to T = k+1
-    query rows per slot: score tiles are (H, T, page_size), the running
-    m/l statistics carry a row axis, and each row t masks to its OWN
-    visible-key count cnt_ref[b, t] (the caller passes lengths + t + 1,
-    which is what makes the speculative chunk causal through the page
-    table — GPT.verify_step_paged). Counts are nondecreasing in t, so the
-    page sweep runs to the LAST row's count and earlier rows simply mask."""
-    if quantized:
-        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
-    else:
-        o_ref, acc_sc, m_sc, l_sc = rest
-    b, p = pl.program_id(0), pl.program_id(1)
-    n_p = pl.num_programs(1)
-
-    @pl.when(p == 0)
-    def _init():
-        acc_sc[:] = jnp.zeros_like(acc_sc)
-        m_sc[:] = jnp.full_like(m_sc, M_INIT)
-        l_sc[:] = jnp.zeros_like(l_sc)
-
-    # Per-row counts from SMEM, assembled by a static unroll over the
-    # (small, static) row count; the sweep bound is the last row's count.
-    counts = jnp.stack([cnt_ref[b, t] for t in range(n_rows)])  # (T,)
-
-    @pl.when(p * page_size < cnt_ref[b, n_rows - 1])
-    def _compute():
-        q = q_ref[0]  # (H, T, C)
-        k = k_ref[:, 0]  # (H, page_size, C)
-        if quantized:
-            q = q.astype(jnp.float32)
-            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (H, T, page_size) f32
-        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(col < counts[None, :, None], s, MASK)
-
-        m_prev = m_sc[:, :, 0]  # (H, T)
-        l_prev = l_sc[:, :, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        prob = jnp.exp(s - m_new[:, :, None])  # masked entries underflow to 0
-        if quantized:
-            v = v_ref[:, 0].astype(jnp.float32) * vs_ref[0][:, :, None]
-        else:
-            v = v_ref[:, 0]
-        l_new = l_prev * alpha + jnp.sum(prob, axis=-1)
-        pv = jax.lax.dot_general(
-            prob.astype(v.dtype), v,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )  # (H, T, C)
-        acc_sc[:] = acc_sc[:] * alpha[:, :, None] + pv
-        m_sc[:] = jnp.broadcast_to(m_new[:, :, None], m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new[:, :, None], l_sc.shape)
-
-    @pl.when(p == n_p - 1)
-    def _finalize():
-        l = l_sc[:, :, 0]
-        safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc_sc[:] / safe_l[:, :, None]).astype(o_ref.dtype)
 
 
 def paged_verify_attention_kernel(
@@ -416,59 +260,21 @@ def paged_verify_attention_kernel(
     counts: Array,  # (B, T) int32 — keys visible to row t of slot b
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
+    split_k: int = 1,
 ) -> Array:
-    """Multi-row paged attention via the Pallas verify kernel. Returns
-    (B, T, H, C). q is transposed head-major ONCE outside the kernel (a
-    single small XLA transpose per verify forward) so the kernel works in
-    the pool's native (H, ...) layout with no in-kernel transposes."""
-    B, T, H, C = q.shape
-    _, _, page_size, _ = k_pages.shape
-    max_pages = page_table.shape[1]
-    scale = 1.0 / math.sqrt(C)
-    quantized = k_scale is not None
-    q_hm = q.transpose(0, 2, 1, 3)  # (B, H, T, C)
-
-    page_spec = pl.BlockSpec(
-        (H, 1, page_size, C), lambda b, p, pt, cnt: (0, pt[b, p], 0, 0)
+    """Multi-row paged attention via the kernel template (n_rows == T).
+    Returns (B, T, H, C). q is transposed head-major ONCE outside the
+    kernel (a single small XLA transpose per verify forward) so the kernel
+    works in the pool's native (H, ...) layout with no in-kernel
+    transposes. Each row t masks to its OWN visible-key count cnt[b, t]
+    (the caller passes lengths + t + 1, which is what makes the
+    speculative chunk causal through the page table —
+    GPT.verify_step_paged)."""
+    out = paged_attention_template(
+        q.transpose(0, 2, 1, 3),  # (B, H, T, C)
+        k_pages, v_pages, page_table, counts,
+        k_scale, v_scale, split_k=split_k,
     )
-    in_specs = [
-        pl.BlockSpec((1, H, T, C), lambda b, p, pt, cnt: (b, 0, 0, 0)),
-        page_spec,
-        page_spec,
-    ]
-    operands = [q_hm, k_pages, v_pages]
-    if quantized:
-        scale_spec = pl.BlockSpec(
-            (1, H, page_size), lambda b, p, pt, cnt: (pt[b, p], 0, 0)
-        )
-        in_specs += [scale_spec, scale_spec]
-        operands += [k_scale, v_scale]
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, max_pages),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, H, T, C), lambda b, p, pt, cnt: (b, 0, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((H, T, C), jnp.float32),
-            pltpu.VMEM((H, T, _STATS_LANES), jnp.float32),
-            pltpu.VMEM((H, T, _STATS_LANES), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(
-            _verify_kernel, scale=scale, page_size=page_size, n_rows=T,
-            quantized=quantized,
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, T, C), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
-        ),
-        interpret=_interpret(),
-    )(page_table.astype(jnp.int32), counts.astype(jnp.int32), *operands)
     return out.transpose(0, 2, 1, 3)  # (B, T, H, C)
 
 
@@ -480,24 +286,55 @@ def paged_verify_attention_gather(
     counts: Array,  # (B, T) int32
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
+    split_k: int = 1,
 ) -> Array:
     """XLA gather lowering of the multi-row verify attention: pages
     gathered contiguous once (dequantized in int8 mode, like
     prefill_paged_chunk), then per-row count masks over the shared buffer.
     Same mask-then-scale-then-f32-softmax order as
     `paged_attention_gather`, so speculative greedy verify stays
-    token-exact with plain paged decode (pinned by tests/test_spec.py)."""
+    token-exact with plain paged decode (pinned by tests/test_spec.py).
+    split_k > 1 is the same stats-only split as the decode gather (fat
+    score matmul kept, one online block per scores partition,
+    merge_partials outside), applied per row after the per-row count
+    mask."""
     B, T, H, C = q.shape
-    S = page_table.shape[1] * k_pages.shape[2]
+    page_size = k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    S = max_pages * page_size
+    split_k = normalize_split_k(split_k, max_pages)
+    if split_k == 1:
+        kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
+        vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+        scores = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg)
+        valid = jnp.arange(S)[None, None, None, :] < counts[:, None, :, None]
+        scores = jnp.where(valid, scores, float("-inf"))
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32) / math.sqrt(C), axis=-1
+        ).astype(q.dtype)
+        return jnp.einsum("bhtk,bhkc->bthc", probs, vg)  # (B, T, H, C)
+
+    part_len = (max_pages // split_k) * page_size
+    scale = 1.0 / math.sqrt(C)
     kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
     vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
-    scores = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg)
-    valid = jnp.arange(S)[None, None, None, :] < counts[:, None, :, None]
-    scores = jnp.where(valid, scores, float("-inf"))
-    probs = jax.nn.softmax(
-        scores.astype(jnp.float32) / math.sqrt(C), axis=-1
-    ).astype(q.dtype)
-    return jnp.einsum("bhtk,bhkc->bthc", probs, vg)  # (B, T, H, C)
+    s = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg).astype(
+        jnp.float32
+    ) * scale  # (B, H, T, S) — the unsplit fat dot
+    s = jnp.where(
+        jnp.arange(S)[None, None, None] < counts[:, None, :, None], s, MASK
+    )
+    s = s.reshape(B, H, T, split_k, part_len)
+    m = jnp.full((B, H, T, split_k), M_INIT, jnp.float32)
+    l = jnp.zeros((B, H, T, split_k), jnp.float32)
+    m, _, p, l = online_block(m, l, s)
+    acc = jnp.einsum(
+        "bhtsk,bhskc->bhtsc", p.astype(vg.dtype),
+        vg.reshape(B, H, split_k, part_len, C),
+    ).astype(jnp.float32)
+    m, l, acc = merge_partials(m, l, acc, axis=3)
+    out, _ = finalize(m, l, acc, dtype=q.dtype)  # (B, H, T, C)
+    return out.transpose(0, 2, 1, 3)  # (B, T, H, C)
 
 
 def paged_verify_attention(
@@ -510,6 +347,7 @@ def paged_verify_attention(
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
     mesh: tp.Optional[Mesh] = None,
+    split_k: int = 1,
 ) -> Array:
     """Batched multi-row paged attention for speculative verification
     (GPT.verify_step_paged): every slot scores its k+1 candidate positions
@@ -518,11 +356,12 @@ def paged_verify_attention(
     the chunk causal through the cache: all rows' K/V are written before
     the read, and the per-row count hides the later rows.
 
-    Dispatch mirrors `paged_attention`: the Pallas multi-row kernel on TPU
-    (the compiled verify path, bf16 and int8 — interpret-mode parity in
-    tests/test_quant_cache.py), the XLA gather lowering elsewhere; on a
-    tp>1 mesh the kernel runs per shard over H/tp heads via the same
-    full-manual shard_map, collective-free."""
+    Dispatch mirrors `paged_attention`: the template-instantiated multi-row
+    kernel on TPU (bf16 and int8 — interpret-mode parity in
+    tests/test_quant_cache.py and tests/test_split_k.py), the XLA gather
+    lowering elsewhere; on a tp>1 mesh the kernel runs per shard over H/tp
+    heads via the same full-manual shard_map, collective-free, with
+    split_k riding inside each shard."""
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
@@ -536,15 +375,17 @@ def paged_verify_attention(
                 in_specs += [P(None, "tp", None)] * 2
                 args += [k_scale, v_scale]
             fn = _tp_shard_map(
-                lambda *a: paged_verify_attention_kernel(*a),
+                lambda *a: paged_verify_attention_kernel(*a, split_k=split_k),
                 mesh, tuple(in_specs), row_spec,
             )
             return fn(*args)
         return paged_verify_attention_kernel(
-            q, k_pages, v_pages, page_table, counts, k_scale, v_scale
+            q, k_pages, v_pages, page_table, counts, k_scale, v_scale,
+            split_k=split_k,
         )
     if impl == "gather":
         return paged_verify_attention_gather(
-            q, k_pages, v_pages, page_table, counts, k_scale, v_scale
+            q, k_pages, v_pages, page_table, counts, k_scale, v_scale,
+            split_k=split_k,
         )
     raise ValueError(f"unknown paged verify attention impl {impl!r}")

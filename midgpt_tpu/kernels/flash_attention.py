@@ -49,9 +49,16 @@ if not hasattr(pltpu, "CompilerParams"):
     # (midgpt_tpu.utils.compat documents the shim policy).
     pltpu.CompilerParams = pltpu.TPUCompilerParams
 
-# Finite stand-ins for -inf (see module docstring).
-MASK = -1.0e30
-M_INIT = -0.5e30
+# Finite stand-ins for -inf (see module docstring), re-exported from the
+# canonical home of the shared online-softmax math. Kept as module names
+# because the kernel-template/decode/ring modules import them from here
+# historically and the backward kernels below use them directly.
+from midgpt_tpu.ops.online_softmax import (  # noqa: E402
+    M_INIT,
+    MASK,
+    finalize,
+    online_block,
+)
 # lane width of the statistics outputs/scratch (min useful; padded to a
 # 128-lane tile in VMEM but only these lanes are stored in HBM)
 _STATS_LANES = 8
@@ -133,15 +140,20 @@ def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, b
     ) * scale  # (block_q, block_k) f32
     if causal:
         s = _masked(s, iq, 0, block_q, block_k)
-    m = jnp.max(s, axis=-1)  # (block_q,) — every row has >= 1 valid key
-    p = jnp.exp(s - m[:, None])  # masked entries underflow to 0
-    l = jnp.sum(p, axis=-1)
+    # One online_block step from the empty state IS the direct softmax:
+    # alpha underflows to 0, l = sum(p), and every row has >= 1 valid key
+    # so finalize's safe_l/lse guards are bitwise no-ops (l >= 1).
+    m, _, p, l = online_block(
+        jnp.full(s.shape[:-1], M_INIT, jnp.float32),
+        jnp.zeros(s.shape[:-1], jnp.float32),
+        s,
+    )
     pv = jax.lax.dot_general(
         p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[0] = (pv / l[:, None]).astype(o_ref.dtype)
-    lse = m + jnp.log(l)
+    out, lse = finalize(m, l, pv, dtype=o_ref.dtype)
+    o_ref[0] = out
     lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
@@ -164,12 +176,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
         if causal:
             s = _masked(s, iq, ik, block_q, block_k)
 
-        m_prev = m_sc[:, 0]  # (block_q,)
-        l_prev = l_sc[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m_prev - m_new)  # underflows to 0 at first visit
-        p = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        # shared online-softmax update (ops/online_softmax.online_block):
+        # alpha underflows to 0 at first visit, masked entries' p to 0
+        m_new, alpha, p, l_new = online_block(m_sc[:, 0], l_sc[:, 0], s)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -186,10 +195,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scal
 
     @pl.when(ik == n_k - 1)
     def _finalize():
-        l = l_sc[:, 0]
-        safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc_sc[:] / safe_l[:, None]).astype(o_ref.dtype)
-        lse = jnp.where(l > 0, m_sc[:, 0] + jnp.log(safe_l), MASK)
+        out, lse = finalize(m_sc[:, 0], l_sc[:, 0], acc_sc[:], dtype=o_ref.dtype)
+        o_ref[0] = out
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 

@@ -1057,6 +1057,7 @@ class GPT:
         active: Array,  # (B,) bool — False: slot is empty / mid-prefill
         attn_impl: str = "auto",
         mesh=None,  # Optional[Mesh] — tp serving mesh (parallel/serve_tp.py)
+        split_k: int = 1,  # key-sequence partitions per slot (static)
     ) -> tp.Tuple[Array, "PagedKVCache"]:
         """One decode step for B independent requests at B different positions.
 
@@ -1134,7 +1135,7 @@ class GPT:
             vp, vsp = _layer_pages(cv_all, cvs_all, i)
             att = paged_attention(
                 q1, kp, vp, page_table, attn_counts, impl=attn_impl,
-                k_scale=ksp, v_scale=vsp, mesh=mesh,
+                k_scale=ksp, v_scale=vsp, mesh=mesh, split_k=split_k,
             )  # (B, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att[:, None])
             return (x, ck_all, cv_all, cks_all, cvs_all), None
@@ -1163,6 +1164,7 @@ class GPT:
         active: Array,  # (B,) bool
         attn_impl: str = "auto",
         mesh=None,  # Optional[Mesh] — tp serving mesh (parallel/serve_tp.py)
+        split_k: int = 1,  # key-sequence partitions per slot (static)
     ) -> tp.Tuple[Array, "PagedKVCache"]:
         """Score K1 = k+1 candidate tokens per slot in ONE batched paged
         forward — the target side of speculative decoding (sampling/spec.py).
@@ -1231,7 +1233,7 @@ class GPT:
             vp, vsp = _layer_pages(cv_all, cvs_all, i)
             att = paged_verify_attention(
                 q, kp, vp, page_table, attn_counts, impl=attn_impl,
-                k_scale=ksp, v_scale=vsp, mesh=mesh,
+                k_scale=ksp, v_scale=vsp, mesh=mesh, split_k=split_k,
             )  # (B, K1, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att.astype(x.dtype))
             return (x, ck_all, cv_all, cks_all, cvs_all), None

@@ -3,6 +3,14 @@ from midgpt_tpu.ops.rope import rope_table, apply_rope, rotate_interleaved
 from midgpt_tpu.ops.dropout import dropout
 from midgpt_tpu.ops.loss import cross_entropy_loss
 from midgpt_tpu.ops.attention import multihead_attention
+from midgpt_tpu.ops.online_softmax import (
+    MASK,
+    M_INIT,
+    finalize,
+    merge_normalized,
+    merge_partials,
+    online_block,
+)
 
 __all__ = [
     "rms_norm",
@@ -13,4 +21,10 @@ __all__ = [
     "dropout",
     "cross_entropy_loss",
     "multihead_attention",
+    "MASK",
+    "M_INIT",
+    "finalize",
+    "merge_normalized",
+    "merge_partials",
+    "online_block",
 ]

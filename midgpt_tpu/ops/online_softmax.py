@@ -1,0 +1,123 @@
+"""Online-softmax combine primitives — the one copy of the numerically
+stable merge math shared by every attention path that splits the softmax
+reduction:
+
+  * the Pallas paged-attention kernel template
+    (kernels/attention_template.py): per-page running-statistics update and
+    finalize inside kernel bodies, for plain decode and multi-row verify;
+  * the flash-attention forward kernels (kernels/flash_attention.py): the
+    same per-KV-block update over (block_q, block_k) score tiles;
+  * ring attention (parallel/ring_attention.py): merging NORMALIZED
+    per-shard (out, lse) partials as K/V shards rotate past;
+  * split-K paged attention: merging per-partition RAW (m, l, acc)
+    partials emitted by independent grid slices (kernel path) or scan
+    iterations (gather fallback).
+
+Everything here is pure jnp on float32 statistics, so the same functions
+trace inside Pallas kernel bodies (applied to values loaded from refs),
+shard_map bodies, and plain jit.
+
+Masking uses a large-negative FINITE score (`MASK`), with the running max
+seeded at `M_INIT > MASK`: `exp(MASK - m)` underflows to exactly 0, so
+fully-masked rows and partitions contribute nothing and no NaN-scrubbing
+selects are needed in hot loops. A partition that never saw a valid key
+carries exactly `(M_INIT, 0, 0)` and drops out of `merge_partials`;
+`finalize` turns an all-zero weight row into a 0 output (not NaN).
+Callers that pass true -inf scores get the same guarantees: `exp(-inf - m)`
+is exactly 0 and `finalize` guards the 0/0 (tests/test_online_softmax.py).
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Finite stand-ins for -inf (see module docstring). These are the canonical
+# definitions; kernels/flash_attention.py re-exports them for its callers.
+MASK = -1.0e30
+M_INIT = -0.5e30
+
+
+def online_block(
+    m: Array, l: Array, s: Array
+) -> tp.Tuple[Array, Array, Array, Array]:
+    """Fold one raw f32 score block into running statistics (m, l).
+
+    `s` carries the key axis last; `m`/`l` match `s.shape[:-1]`. Returns
+    `(m_new, alpha, p, l_new)`; the caller applies its own PV contraction
+    and rescales its accumulator as `acc = acc * alpha[..., None] + pv` —
+    the contraction shape is the only thing that differs between callers
+    (flash q-tiles, decode heads, verify head×row tiles), so it stays
+    outside this helper.
+    """
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)  # underflows to 0 on the first visit (M_INIT)
+    p = jnp.exp(s - m_new[..., None])  # masked entries underflow to 0
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    return m_new, alpha, p, l_new
+
+
+def merge_normalized(
+    m: Array, l: Array, acc: Array, out_s: Array, lse_s: Array
+) -> tp.Tuple[Array, Array, Array]:
+    """Merge an already-NORMALIZED partial (out_s, lse_s) into raw (m, l, acc).
+
+    The ring-attention step: a visiting K/V shard's softmax is complete, so
+    its output re-enters the running sum with weight `exp(lse_s - m_new)`.
+    Pass `lse_s = MASK` for a partial that must contribute nothing (e.g. a
+    future shard under causal ordering): its beta underflows to exactly 0.
+    """
+    m_new = jnp.maximum(m, lse_s)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(lse_s - m_new)
+    acc = acc * alpha[..., None] + out_s.astype(jnp.float32) * beta[..., None]
+    l = l * alpha + beta
+    return m_new, l, acc
+
+
+def merge_partials(
+    m: Array, l: Array, acc: Array, axis: int = 0
+) -> tp.Tuple[Array, Array, Array]:
+    """Reduce stacked RAW split-K partials along `axis`.
+
+    Each slice along `axis` is an independent online-softmax sweep over a
+    disjoint span of keys: m_i its running max, l_i its (unnormalized)
+    weight sum, acc_i its weighted-value accumulator. The merged stats are
+
+        m = max_i m_i,   l = sum_i l_i * exp(m_i - m),
+        acc = sum_i acc_i * exp(m_i - m),
+
+    after which `finalize` recovers the exact softmax over the union of the
+    spans. An all-masked partition carries (M_INIT, 0, 0) and contributes
+    exactly 0.
+    """
+    axis = axis % m.ndim
+    m_tot = jnp.max(m, axis=axis)
+    w = jnp.exp(m - jnp.expand_dims(m_tot, axis))
+    l_tot = jnp.sum(l * w, axis=axis)
+    acc_tot = jnp.sum(acc * jnp.expand_dims(w, axis=-1), axis=axis)
+    return m_tot, l_tot, acc_tot
+
+
+def finalize(
+    m: Array, l: Array, acc: Array, dtype=None
+) -> tp.Tuple[Array, Array]:
+    """(out, lse) from final raw statistics.
+
+    Rows with l == 0 — nothing visible: an inactive slot, a fully-masked
+    row, every partition masked — emit 0 output and `lse = MASK` rather
+    than NaN. Rows with l > 0 divide by l exactly (the `maximum` guard is
+    a bitwise no-op there), so callers that can prove l >= 1 (ring
+    attention seeds its running sum with a complete local softmax) lose
+    nothing by sharing this finalize.
+    """
+    safe_l = jnp.maximum(l, 1e-30)
+    out = acc / safe_l[..., None]
+    if dtype is not None:
+        out = out.astype(dtype)
+    lse = jnp.where(l > 0, m + jnp.log(safe_l), MASK)
+    return out, lse

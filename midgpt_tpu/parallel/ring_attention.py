@@ -59,13 +59,16 @@ import importlib
 # the real module (the kernels package re-exports a same-named function)
 fa = importlib.import_module("midgpt_tpu.kernels.flash_attention")
 from midgpt_tpu.ops.attention import flash_block_sizes
+from midgpt_tpu.ops.online_softmax import (
+    MASK,
+    M_INIT,
+    finalize,
+    merge_normalized,
+    online_block,
+)
 from midgpt_tpu.utils.compat import axis_size, shard_map
 
 Array = jax.Array
-
-# Finite stand-ins for -inf (same scheme as kernels/flash_attention.py).
-MASK = -1.0e30
-M_INIT = -0.5e30
 
 
 def _auto_use_kernel() -> bool:
@@ -164,10 +167,7 @@ def _pair_fwd_jnp(
         )
         if causal:
             s = jnp.where(rows >= (col0 + cols), s, MASK)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])  # masked entries underflow to 0
-        l_new = l * alpha + jnp.sum(p, axis=-1)
+        m_new, alpha, p, l_new = online_block(m, l, s)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhqk,bhkc->bhqc", p.astype(v_blk.dtype), v_blk
         ).astype(jnp.float32)
@@ -182,8 +182,8 @@ def _pair_fwd_jnp(
     init = (zero_q[..., 0] + M_INIT, zero_q[..., 0], zero_q)
     (m, l, acc), _ = jax.lax.scan(kv_block_step, init, (kb, vb, col0))
     # every row has >= 1 valid key in both pair cases (diagonal: itself)
-    out = (acc / l[..., None]).astype(q.dtype)
-    return out, m + jnp.log(l)
+    out, lse = finalize(m, l, acc, dtype=q.dtype)
+    return out, lse
 
 
 def _pair_bwd_jnp(
@@ -296,17 +296,14 @@ def _ring_fwd(q, k, v, axis_name, block_size, use_kernel):
         # merge (compute still runs: static shapes under scan).
         o_s, lse_s = _pair_fwd(q, k_c, v_c, False, block_size, use_kernel)
         lse_s = jnp.where(j < g, lse_s, MASK)
-        m_new = jnp.maximum(m, lse_s)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(lse_s - m_new)
-        acc = acc * alpha[..., None] + o_s.astype(jnp.float32) * beta[..., None]
-        l = l * alpha + beta
+        m_new, l, acc = merge_normalized(m, l, acc, o_s, lse_s)
         return (k_c, v_c, m_new, l, acc), None
 
     init = (k, v, lse_d, lse_d * 0 + 1.0, out_d.astype(jnp.float32))
     (_, _, m, l, acc), _ = jax.lax.scan(ring_step, init, jnp.arange(1, n))
-    out = (acc / l[..., None]).astype(q.dtype)
-    lse = m + jnp.log(l)
+    # l >= exp(lse_d - m) > 0 always (the local diagonal softmax seeds the
+    # running sum), so the shared finalize is a bitwise no-op guard here.
+    out, lse = finalize(m, l, acc, dtype=q.dtype)
     return out, (q, k, v, out, lse)
 
 

@@ -148,7 +148,7 @@ def _serve_prefill_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11, 13), donate_argnums=(3,)
+    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11, 13, 14), donate_argnums=(3,)
 )
 def _serve_decode_chunk(
     config,
@@ -165,6 +165,7 @@ def _serve_decode_chunk(
     attn_impl: str,
     key=None,
     mesh=None,  # static (Mesh hashes) — tp serving mesh, None = single chip
+    split_k: int = 1,  # static — key partitions per slot (docs/SERVING.md)
 ):
     """n_steps decode+sample steps for the whole slot batch as ONE device
     program. Inactive slots hold their token and length (their writes land
@@ -178,7 +179,7 @@ def _serve_decode_chunk(
             k = None
         logits, cache = GPT.decode_step_paged(
             config, params, token, cache, page_table, lengths, active,
-            attn_impl=attn_impl, mesh=mesh,
+            attn_impl=attn_impl, mesh=mesh, split_k=split_k,
         )
         cache = _maybe_constrain(cache, mesh)
         if temperature == 0.0:
@@ -196,7 +197,7 @@ def _serve_decode_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11, 13), donate_argnums=(3,)
+    jax.jit, static_argnums=(0, 7, 8, 9, 10, 11, 13, 14), donate_argnums=(3,)
 )
 def _spec_draft_chunk(
     config,  # the DRAFT model's GPTConfig
@@ -213,6 +214,7 @@ def _spec_draft_chunk(
     attn_impl: str,
     key=None,
     mesh=None,  # static — tp serving mesh, None = single chip
+    split_k: int = 1,  # static — key partitions per slot
 ):
     """k_steps autoregressive draft proposals for the whole slot batch as
     ONE device program: a scan of paged decode steps of the draft model
@@ -228,7 +230,7 @@ def _spec_draft_chunk(
             key, k = jax.random.split(key)
         logits, cache = GPT.decode_step_paged(
             config, params, token, cache, page_table, lengths, active,
-            attn_impl=attn_impl, mesh=mesh,
+            attn_impl=attn_impl, mesh=mesh, split_k=split_k,
         )
         cache = _maybe_constrain(cache, mesh)
         lf = logits.astype(jnp.float32)
@@ -250,7 +252,7 @@ def _spec_draft_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 9, 10, 11, 12, 14), donate_argnums=(5,)
+    jax.jit, static_argnums=(0, 9, 10, 11, 12, 14, 15), donate_argnums=(5,)
 )
 def _spec_verify_chunk(
     config,
@@ -268,6 +270,7 @@ def _spec_verify_chunk(
     attn_impl: str,
     key=None,
     mesh=None,  # static — tp serving mesh, None = single chip
+    split_k: int = 1,  # static — key partitions per slot
 ):
     """One batched paged verify forward over [pending, d_1..d_k] plus the
     rejection sampler (sampling/spec.py): returns (cache, n_accept (B,),
@@ -279,7 +282,7 @@ def _spec_verify_chunk(
     )  # (B, k+1)
     logits, cache = GPT.verify_step_paged(
         config, params, tokens, cache, page_table, lengths, active,
-        attn_impl=attn_impl, mesh=mesh,
+        attn_impl=attn_impl, mesh=mesh, split_k=split_k,
     )
     cache = _maybe_constrain(cache, mesh)
     n_accept, out = speculative_accept(
@@ -454,6 +457,7 @@ class ServeEngine:
         seed: int = 0,
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "auto",
+        split_k="auto",  # "auto" | int — key partitions per attention call
         max_backlog_pages: tp.Optional[int] = None,
         prefix_cache: bool = False,
         draft_params: tp.Optional[GPTParams] = None,
@@ -520,6 +524,16 @@ class ServeEngine:
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
         self.attn_impl = attn_impl
+        # Split-K policy (docs/SERVING.md "Split-K decode"): "auto" picks a
+        # per-round pow2 split from the page bucket (_split_bucket) — short
+        # traffic resolves to 1 and compiles/runs the classic unsplit
+        # program; an int forces that split for every round (tests). Like
+        # the page bucket and the mesh, the resolved split is a trailing
+        # static jit arg: each (bucket, split) pair is its own compile-cache
+        # entry, and split programs never perturb unsplit ones.
+        if split_k != "auto" and (not isinstance(split_k, int) or split_k < 1):
+            raise ValueError(f"split_k must be 'auto' or a positive int, got {split_k!r}")
+        self.split_k = split_k
         self.max_pages_per_slot = -(-config.block_size // page_size)
         cache_dtype = normalize_cache_dtype(cache_dtype)
         self.cache_dtype = cache_dtype
@@ -1144,6 +1158,24 @@ class ServeEngine:
             b *= 2
         return min(b, self.max_pages_per_slot)
 
+    def _split_bucket(self, max_tokens: int) -> int:
+        """Static split-K factor for a round whose widest slot spans
+        `max_tokens` positions: double the split for every page-bucket
+        doubling past 512 tokens (so each partition sweeps >= 512 tokens),
+        capped at 8. Traffic at or under 512 tokens resolves to 1 — the
+        unsplit program, byte-identical to a split_k-naive engine — so the
+        rule only engages (and only adds compile-cache entries) when long
+        requests actually arrive. Forced int engines skip the rule; the
+        kernels normalize the forced value to a pow2 divisor of the round's
+        table width (kernels/attention_template.normalize_split_k)."""
+        if self.split_k != "auto":
+            return self.split_k
+        tokens = self._page_bucket(max_tokens) * self.page_size
+        split = 1
+        while split < 8 and tokens // (2 * split) >= 512:
+            split *= 2
+        return split
+
     def _prefill_round(self) -> None:
         """Advance every mid-prompt slot by one (padded) chunk.
 
@@ -1274,9 +1306,8 @@ class ServeEngine:
             key = None
         else:
             self._key, key = jax.random.split(self._key)
-        bucket = self._page_bucket(
-            max(self.slots[i].length for i in active_idx) + n
-        )
+        round_span = max(self.slots[i].length for i in active_idx) + n
+        bucket = self._page_bucket(round_span)
         self.cache, toks = _serve_decode_chunk(
             self.config,
             self.params,
@@ -1292,6 +1323,7 @@ class ServeEngine:
             self.attn_impl,
             key,
             self.mesh,
+            self._split_bucket(round_span),
         )
         toks = np.asarray(toks)  # (n, B) — forces the dispatch
         t_done = self._clock()
@@ -1359,9 +1391,9 @@ class ServeEngine:
             key_d = key_v = None
         else:
             self._key, key_d, key_v = jax.random.split(self._key, 3)
-        bucket = self._page_bucket(
-            max(self.slots[i].length for i in active_idx) + k + 1
-        )
+        round_span = max(self.slots[i].length for i in active_idx) + k + 1
+        bucket = self._page_bucket(round_span)
+        split_k = self._split_bucket(round_span)
         table = jnp.asarray(self._page_table(bucket))
         token_j = jnp.asarray(token)
         lengths_j = jnp.asarray(lengths)
@@ -1390,6 +1422,7 @@ class ServeEngine:
             self.attn_impl,
             key_d,
             self.mesh,
+            split_k,
         )
         if shared:
             self.cache = draft_cache_out
@@ -1411,6 +1444,7 @@ class ServeEngine:
             self.attn_impl,
             key_v,
             self.mesh,
+            split_k,
         )
         n_accept = np.asarray(n_accept)
         out = np.asarray(out)  # forces both dispatches

@@ -13,6 +13,7 @@ import sys
 from midgpt_tpu.analysis.bench_contract import (
     check_bench_stdout,
     check_serve_bench,
+    check_serve_longctx_bench,
     check_serve_prefix_bench,
     check_serve_slo_bench,
     check_serve_tp_bench,
@@ -181,6 +182,62 @@ def test_bench_serve_tp_emits_conformant_json_line(capsys):
     )
 
 
+def test_bench_serve_longctx_emits_conformant_json_line(capsys):
+    """--long-ctx mode: the serve_longctx profile (split-K decode A/B at a
+    long and a short context) must hold the one-JSON-line contract with
+    EXACT greedy parity, the auto bucket rule engaged at t_long and
+    resolving to the unsplit program at t_short. Small t_long=1024 point
+    (the smallest the profile admits), tiny model, 2 quick-train steps —
+    structure check, not a latency claim."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--long-ctx",
+            "--t-long", "1024",
+            "--t-short", "64",
+            "--rounds", "2",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "32",
+            "--decode-chunk", "4",
+            "--train-steps", "2",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_longctx")
+    assert not problems, problems
+    assert rec["greedy_match_frac"] == 1.0
+    assert rec["split_k_long"] == 2  # the 1024-token bucket
+    assert rec["split_k_short"] == 1  # auto: short traffic stays unsplit
+    assert rec["ms_round_long_split"] > 0 and rec["ms_round_long_unsplit"] > 0
+    # checker drift behavior on the real record: inexact parity, a split
+    # bucket leaking into short traffic, a vacuous (unsplit or short-T)
+    # long arm, and a dead timing are contract violations, not numbers
+    assert any(
+        "greedy_match_frac" in p
+        for p in check_serve_longctx_bench(dict(rec, greedy_match_frac=0.99))
+    )
+    assert any(
+        "split_k_short" in p
+        for p in check_serve_longctx_bench(dict(rec, split_k_short=2))
+    )
+    assert any(
+        "split_k_long" in p
+        for p in check_serve_longctx_bench(dict(rec, split_k_long=1))
+    )
+    assert any(
+        "t_long" in p for p in check_serve_longctx_bench(dict(rec, t_long=512))
+    )
+    assert any(
+        "ms_round_long_split" in p
+        for p in check_serve_longctx_bench(dict(rec, ms_round_long_split=0.0))
+    )
+
+
 def test_loadgen_prefix_cache_emits_hit_rate(capsys):
     """tools/loadgen.py --prefix-cache: the serve_slo line still conforms
     and carries per-point + headline prefix_hit_rate fields."""
@@ -202,6 +259,28 @@ def test_loadgen_prefix_cache_emits_hit_rate(capsys):
     for p in rec["points"]:
         assert 0.0 <= p["prefix_hit_rate"] <= 1.0
     assert 0.0 <= rec["prefix_hit_rate"] <= 1.0
+
+
+def test_loadgen_long_mixture_emits_conformant_serve_slo_line(capsys):
+    """tools/loadgen.py --long-frac: the long-prompt/long-output mixture
+    keeps the serve_slo line conformant and records the mixture knob. The
+    pool default stays the auto rule's 27-page geometry below the
+    long-context regime, so this composes with every other loadgen pin."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "loadgen.py"),
+        [
+            "loadgen.py",
+            "--rates", "30,90",
+            "--n-requests", "4",
+            "--long-frac", "0.5",
+            "--seed", "0",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_slo")
+    assert not problems, problems
+    assert rec["long_frac"] == 0.5
+    assert rec["points"][0]["completed"] >= 1
 
 
 def test_loadgen_emits_conformant_serve_slo_line(capsys):

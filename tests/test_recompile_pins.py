@@ -323,3 +323,19 @@ def test_audit_suite_passes_on_cpu_mesh():
     assert report["tp_draft_int8_loop_all_reduces"] == 2
     for name in ("tp_decode", "tp_decode_int8", "tp_verify", "tp_draft_int8"):
         assert report[f"{name}_loop_pool_copies"] == 0
+    # split-K extensions: sequence partitioning is a softmax-statistics
+    # restructure, so the split_k=4 lowerings must add ZERO pool traffic
+    # (no pool- or scale-sized copy in any decode/verify loop) and zero
+    # collectives beyond the same 2*n_layer megatron all-reduces the
+    # unsplit tp program carries
+    assert report["split_decode_while_bodies"], "split decode lost its scan?"
+    for key in (
+        "split_decode_while_bodies",
+        "split_decode_loop_pool_copies",
+        "split_verify_loop_pool_copies",
+        "split_decode_int8_loop_pool_copies",
+        "split_decode_int8_loop_scale_copies",
+    ):
+        assert all(n == 0 for n in report[key].values()), key
+    assert report["tp_decode_split_loop_all_reduces"] == 4
+    assert report["tp_decode_split_loop_pool_copies"] == 0

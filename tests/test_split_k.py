@@ -1,0 +1,334 @@
+"""Split-K paged attention (kernels/attention_template.py + the gather
+siblings in kernels/decode_attention.py): sequence-partitioned decode and
+verify must be NUMERICALLY INTERCHANGEABLE with the unsplit pass.
+
+Three layers of pinning, mirroring tests/test_decode_attention.py and
+tests/test_quant_cache.py:
+
+* op level — the gather split lowering (fat score matmul, partitioned
+  softmax statistics, ops/online_softmax merge) vs the unsplit gather and
+  the dense masked reference, decode and verify, f32 and int8, split 2/4/8;
+* kernel level — the template's split grid (per-partition raw partials,
+  merged outside the kernel) in interpret mode vs the gather paths;
+* engine level — greedy token streams bit-identical with split-K forced
+  on vs off across cache dtype, self-draft speculation, prefix cache, and
+  a tp=2 serving mesh, plus the recompile pin: a forced-split engine
+  compiles ONE decode program and replays request-mix changes with zero
+  compiles (split_k is a static, not per-request state).
+
+Pool geometry note: engine tests use num_pages=33, disjoint from the
+25-page geometry whose compile counts tests/test_recompile_pins.py pins
+from a pristine baseline and from the 29/31-page tp geometries.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.analysis.hlo_audit import CompileCounter, jit_cache_size
+from midgpt_tpu.kernels.attention_template import normalize_split_k
+from midgpt_tpu.kernels.decode_attention import (
+    paged_attention_gather,
+    paged_attention_kernel,
+    paged_verify_attention_gather,
+    paged_verify_attention_kernel,
+)
+from midgpt_tpu.models.gpt import GPT, GPTConfig
+from midgpt_tpu.ops.quant import quantize_q8
+from midgpt_tpu.parallel.serve_tp import make_serve_mesh
+from midgpt_tpu.sampling.serve import ServeEngine, _serve_decode_chunk
+from midgpt_tpu.sampling.spec import self_draft
+
+B, H, C = 3, 2, 128  # C spans the full Mosaic lane dim
+PS, NP, MP = 8, 7, 4  # page_size, pool pages, max logical pages/slot
+
+CFG = GPTConfig(block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32)
+
+
+# ----------------------------------------------------------------------
+# normalize_split_k: the static-factor contract every caller leans on
+# ----------------------------------------------------------------------
+
+
+def test_normalize_split_k():
+    # identity on pow2 divisors
+    assert normalize_split_k(1, 8) == 1
+    assert normalize_split_k(4, 8) == 4
+    assert normalize_split_k(8, 8) == 8
+    # pow2 floor of a non-pow2 request
+    assert normalize_split_k(6, 8) == 4
+    # clamped to the table width BEFORE the pow2 floor (8 > 6 must give a
+    # divisor of 6, not a stale pow2 of the request)
+    assert normalize_split_k(8, 6) == 2
+    # halves until it divides an odd width
+    assert normalize_split_k(4, 7) == 1
+    assert normalize_split_k(4, 12) == 4
+    # floor at 1 for degenerate requests
+    assert normalize_split_k(0, 8) == 1
+    assert normalize_split_k(-3, 8) == 1
+
+
+def test_split_bucket_rule():
+    """The auto rule (docs/SERVING.md "Split-K decode"): one doubling per
+    page-bucket doubling past 512 tokens, so every partition sweeps >= 512
+    tokens; <= 512 stays on the unsplit program."""
+    cfg = GPTConfig(
+        block_size=4096, vocab_size=96, n_layer=1, n_head=1, n_embd=32
+    )
+    params = GPT.init(cfg, jax.random.PRNGKey(0))
+    eng = ServeEngine(
+        cfg, params, max_slots=1, page_size=8, num_pages=9,
+        prefill_chunk=8, decode_chunk=8, temperature=0.0,
+        cache_dtype=jnp.float32,
+    )
+    assert [eng._split_bucket(t) for t in (64, 512, 1024, 2048, 4096)] == [
+        1, 1, 2, 4, 8
+    ]
+    forced = ServeEngine(
+        cfg, params, max_slots=1, page_size=8, num_pages=9,
+        prefill_chunk=8, decode_chunk=8, temperature=0.0,
+        cache_dtype=jnp.float32, split_k=4,
+    )
+    assert forced._split_bucket(64) == 4  # forced engines skip the rule
+    with pytest.raises(ValueError, match="split_k"):
+        ServeEngine(
+            cfg, params, max_slots=1, page_size=8, num_pages=9,
+            prefill_chunk=8, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32, split_k=0,
+        )
+
+
+# ----------------------------------------------------------------------
+# Op level: gather split vs unsplit vs dense reference
+# ----------------------------------------------------------------------
+
+
+def _problem(seed=0, max_pages=8):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (B, H, C), jnp.float32)
+    k_pages = jax.random.normal(keys[1], (H, NP, PS, C), jnp.float32)
+    v_pages = jax.random.normal(keys[2], (H, NP, PS, C), jnp.float32)
+    rng = np.random.default_rng(seed)
+    page_table = jnp.asarray(
+        rng.integers(0, NP, (B, max_pages)), jnp.int32
+    )
+    # ragged: an inactive slot, a page-unaligned length, a full slot
+    lengths = jnp.asarray([0, 19, max_pages * PS], jnp.int32)
+    return q, k_pages, v_pages, page_table, lengths
+
+
+def _quantize(pages):
+    qp, s = quantize_q8(pages.transpose(1, 0, 2, 3))
+    return qp.transpose(1, 0, 2, 3), s
+
+
+def _dense_decode(q, k_pages, v_pages, page_table, lengths):
+    out = []
+    for b in range(q.shape[0]):
+        kb = np.concatenate(
+            [np.asarray(k_pages)[:, p] for p in np.asarray(page_table)[b]],
+            axis=1,
+        )
+        vb = np.concatenate(
+            [np.asarray(v_pages)[:, p] for p in np.asarray(page_table)[b]],
+            axis=1,
+        )
+        n = int(lengths[b])
+        if n == 0:
+            out.append(np.zeros((H, C), np.float32))
+            continue
+        s = np.einsum("hc,hkc->hk", np.asarray(q)[b], kb) / math.sqrt(C)
+        s[:, n:] = -np.inf
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out.append(np.einsum("hk,hkc->hc", p, vb))
+    return np.stack(out)
+
+
+@pytest.mark.parametrize("split", [2, 4, 8])
+def test_gather_split_matches_unsplit_and_dense(split):
+    q, kp, vp, pt, ln = _problem()
+    base = np.asarray(paged_attention_gather(q, kp, vp, pt, ln, split_k=1))
+    got = np.asarray(paged_attention_gather(q, kp, vp, pt, ln, split_k=split))
+    # the unsplit pass NaNs the length-0 slot (masked downstream); the
+    # split merge's l==0 finalize emits finite zeros there instead
+    np.testing.assert_allclose(got[1:], base[1:], atol=3e-6, rtol=3e-6)
+    assert np.isfinite(got).all() and not np.abs(got[0]).any()
+    dense = _dense_decode(q, kp, vp, pt, ln)
+    np.testing.assert_allclose(got[1:], dense[1:], atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("split", [2, 4])
+def test_gather_split_matches_unsplit_int8(split):
+    q, kp, vp, pt, ln = _problem(seed=1)
+    kq, ks = _quantize(kp)
+    vq, vs = _quantize(vp)
+    base = np.asarray(
+        paged_attention_gather(q, kq, vq, pt, ln, ks, vs, split_k=1)
+    )
+    got = np.asarray(
+        paged_attention_gather(q, kq, vq, pt, ln, ks, vs, split_k=split)
+    )
+    np.testing.assert_allclose(got[1:], base[1:], atol=3e-6, rtol=3e-6)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["f32", "int8"])
+@pytest.mark.parametrize("split", [2, 4])
+def test_verify_gather_split_matches_unsplit(split, quant):
+    T = 5
+    q, kp, vp, pt, ln = _problem(seed=2)
+    qv = jax.random.normal(jax.random.PRNGKey(7), (B, T, H, C), jnp.float32)
+    counts = jnp.minimum(ln[:, None] + jnp.arange(T)[None] + 1, MP * PS * 2)
+    counts = jnp.where(ln[:, None] > 0, counts, 0)
+    args = (qv, kp, vp, pt, counts)
+    kw = {}
+    if quant:
+        kq, ks = _quantize(kp)
+        vq, vs = _quantize(vp)
+        args = (qv, kq, vq, pt, counts)
+        kw = dict(k_scale=ks, v_scale=vs)
+    base = np.asarray(paged_verify_attention_gather(*args, split_k=1, **kw))
+    got = np.asarray(paged_verify_attention_gather(*args, split_k=split, **kw))
+    np.testing.assert_allclose(got[1:], base[1:], atol=3e-6, rtol=3e-6)
+    assert np.isfinite(got).all() and not np.abs(got[0]).any()
+
+
+# ----------------------------------------------------------------------
+# Kernel level: template split grid in interpret mode
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["f32", "int8"])
+@pytest.mark.parametrize("split", [1, 4])
+def test_kernel_split_matches_gather_decode(split, quant):
+    q, kp, vp, pt, ln = _problem(seed=3)
+    kw, args = {}, (q, kp, vp, pt, ln)
+    if quant:
+        kq, ks = _quantize(kp)
+        vq, vs = _quantize(vp)
+        args = (q, kq, vq, pt, ln)
+        kw = dict(k_scale=ks, v_scale=vs)
+    want = np.asarray(paged_attention_gather(*args, split_k=1, **kw))
+    got = np.asarray(paged_attention_kernel(*args, split_k=split, **kw))
+    np.testing.assert_allclose(got[1:], want[1:], atol=2e-5, rtol=2e-5)
+    assert np.isfinite(got).all() and not np.abs(got[0]).any()
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["f32", "int8"])
+@pytest.mark.parametrize("split", [1, 4])
+def test_kernel_split_matches_gather_verify(split, quant):
+    T = 3
+    _, kp, vp, pt, ln = _problem(seed=4)
+    qv = jax.random.normal(jax.random.PRNGKey(9), (B, T, H, C), jnp.float32)
+    counts = jnp.minimum(ln[:, None] + jnp.arange(T)[None] + 1, MP * PS * 2)
+    counts = jnp.where(ln[:, None] > 0, counts, 0)
+    kw, args = {}, (qv, kp, vp, pt, counts)
+    if quant:
+        kq, ks = _quantize(kp)
+        vq, vs = _quantize(vp)
+        args = (qv, kq, vq, pt, counts)
+        kw = dict(k_scale=ks, v_scale=vs)
+    want = np.asarray(paged_verify_attention_gather(*args, split_k=1, **kw))
+    got = np.asarray(paged_verify_attention_kernel(*args, split_k=split, **kw))
+    np.testing.assert_allclose(got[1:], want[1:], atol=2e-5, rtol=2e-5)
+    assert np.isfinite(got).all() and not np.abs(got[0]).any()
+
+
+# ----------------------------------------------------------------------
+# Engine level: greedy streams bit-identical, split on vs off
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT.init(CFG, jax.random.PRNGKey(0))
+
+
+def _trace(seed, n=4):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(5, 30, size=n)
+    return (
+        [rng.integers(1, CFG.vocab_size, size=int(l)).tolist() for l in lens],
+        [int(b) for b in rng.integers(5, 18, size=n)],
+    )
+
+
+def _run(params, split_k, *, dtype=jnp.float32, prefix=False, spec=False,
+         mesh=None, seed=0, num_pages=33):
+    skw = {}
+    if spec:
+        dcfg, dparams = self_draft(CFG, params, 1)
+        skw = dict(draft_params=dparams, draft_config=dcfg,
+                   draft_shares_cache=True, spec_k_max=4)
+    eng = ServeEngine(
+        CFG, params, max_slots=3, page_size=8, num_pages=num_pages,
+        prefill_chunk=8, decode_chunk=8, temperature=0.0, cache_dtype=dtype,
+        prefix_cache=prefix, mesh=mesh, split_k=split_k, **skw,
+    )
+    prompts, budgets = _trace(seed)
+    uids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+    done = eng.run()
+    return [done[u].tokens.tolist() for u in uids]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, "int8"], ids=["f32", "int8"])
+@pytest.mark.parametrize(
+    "feature", ["plain", "spec", "prefix", "tp"]
+)
+def test_engine_greedy_streams_identical_split_on_off(params, dtype, feature):
+    """The acceptance pin: forcing split_k=4 changes WHICH program decodes
+    but not one emitted token, under every serving feature it composes
+    with. Exact list equality — split-K is a lowering choice, not a
+    numeric mode (same f32 softmax, same merge identity,
+    tests/test_online_softmax.py)."""
+    kw = dict(dtype=dtype)
+    if feature == "spec":
+        kw["spec"] = True
+    elif feature == "prefix":
+        kw["prefix"] = True
+    elif feature == "tp":
+        kw["mesh"] = make_serve_mesh(tp_size=2)
+    base = _run(params, 1, **kw)
+    split = _run(params, 4, **kw)
+    assert split == base
+    auto = _run(params, "auto", **kw)
+    assert auto == base  # <= 512-token traffic: auto IS the unsplit program
+
+
+def test_forced_split_engine_compiles_one_decode_program(params):
+    """Recompile pin: split_k is a static jit arg, so a forced-split
+    engine compiles exactly ONE new decode program (the split_k=4
+    instantiation) on its first mix, and three further distinct request
+    mixes compile NOTHING — request lengths stay plain data under
+    split-K. Mix design follows tests/test_recompile_pins.py: prompts
+    25..47 with max_new ≡ 1 (mod 8) pin the pow2 page bucket at the 8-page
+    cap from the first decode round, so "one program" means one — not one
+    per bucket the trace wanders through. Geometry (35-page pool) is
+    disjoint from this file's other engine runs (33) and from the pristine
+    25-page pins, so the count starts cold."""
+
+    def mix(lengths, max_new, seed):
+        eng = ServeEngine(
+            CFG, params, max_slots=3, page_size=8, num_pages=35,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32, split_k=4,
+        )
+        rng = np.random.default_rng(seed)
+        uids = {
+            eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+            for n, m in zip(lengths, max_new)
+        }
+        assert set(eng.run()) == uids
+
+    d0 = jit_cache_size(_serve_decode_chunk)
+    mix((25, 34, 47), (9, 17, 17), seed=0)
+    assert jit_cache_size(_serve_decode_chunk) - d0 == 1
+    with CompileCounter() as cc:
+        mix((26, 33, 40), (9, 17, 9), seed=1)
+        mix((29, 41, 45), (17, 9, 17), seed=2)
+        mix((31, 38, 47), (17, 17, 9), seed=3)
+    assert cc.count == 0, f"split-K mix change recompiled {cc.count}"
+    assert jit_cache_size(_serve_decode_chunk) - d0 == 1

@@ -81,7 +81,7 @@ def _paged_bench(args, cfg, params, kv_dtype: str) -> float:
             cache, toks = _serve_decode_chunk(
                 cfg, params, tok, cache, table,
                 jnp.full((B,), lengths, jnp.int32), active,
-                chunk, 0.0, None, None, "auto", None,
+                chunk, 0.0, None, None, "auto", None, None, args.split_k,
             )
             tok = toks[-1]
             lengths += chunk
@@ -108,7 +108,13 @@ def main() -> int:
                    help="bench the paged serve decode chunk instead of the "
                    "contiguous engine (required to compare dtypes on the "
                    "same code path)")
+    p.add_argument("--split-k", type=int, default=1,
+                   help="key-sequence partitions per attention call (paged "
+                   "path only; normalized to a pow2 divisor of the table "
+                   "width — docs/SERVING.md 'Split-K decode')")
     args = p.parse_args()
+    if args.split_k != 1:
+        args.paged = True
     if args.kv_dtype == "int8":
         args.paged = True
 
@@ -130,8 +136,9 @@ def main() -> int:
         # paged attention reads O(used length): mean over the run
         read_len = args.prompt + args.tokens // 2
         est = est_kv_bytes_per_token(cfg, args.kv_dtype, read_len)
+        tag = f",split{args.split_k}" if args.split_k != 1 else ""
         print(
-            f"decode[paged,{args.kv_dtype}]: {ms_tok:.2f} ms/token  "
+            f"decode[paged,{args.kv_dtype}{tag}]: {ms_tok:.2f} ms/token  "
             f"({1000 * args.batch / ms_tok:,.0f} tok/s total, batch "
             f"{args.batch}, prompt {args.prompt}, {args.tokens} new)  "
             f"est_kv_bytes/token={est:,} (per slot, mean len {read_len})"

@@ -463,6 +463,175 @@ def _prefix_bench(args, cfg, params, cache_dtype) -> int:
     return 0
 
 
+def _longctx_bench(args) -> int:
+    """--long-ctx mode: the split-K decode A/B ('serve_longctx' profile,
+    analysis/bench_contract.py).
+
+    Three measurements, all through the real serve dispatch:
+
+      * long point — decode-round latency of ONE active slot whose visible
+        length ends at --t-long, unsplit vs the engine's auto split
+        (docs/SERVING.md 'Split-K decode': the single-long-request regime
+        is where an unsplit sweep serializes the whole key sequence);
+      * short point — the same at --t-short. The no-regression guarantee
+        at short T is STRUCTURAL: the auto bucket rule picks split 1 there
+        (reported as split_k_short), so the engine runs the byte-identical
+        pre-split-K program. The forced-split short latency is also
+        reported as diagnostic context for the bucket threshold.
+      * parity — the same greedy trace through two engines (forced split 4
+        vs unsplit) on a quick-fitted model at a 1024-token block; the
+        reported greedy_match_frac must be EXACTLY 1.0 (split-K reorders
+        f32 reductions, so this pins that the margins survive — the same
+        matrix tests/test_split_k.py locks per mode).
+
+    Latency harness: raw `_serve_decode_chunk` calls (the engine's decode
+    program), B=1, page table width rounded UP to a pow2 so the requested
+    split divides it (a 513-page natural width would normalize every split
+    back to 1 — the same rounding the engine's page buckets guarantee).
+    Median of --rounds timed rounds after one warm round; sync per round
+    via float() (CLAUDE.md: block_until_ready does not cross the tunnel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from midgpt_tpu.models.gpt import GPT, GPTConfig, PagedKVCache
+    from midgpt_tpu.sampling.serve import ServeEngine, _serve_decode_chunk
+
+    ps, chunk, rounds = args.page_size, args.decode_chunk, args.rounds
+    on_tpu = jax.default_backend() == "tpu"
+    baseline_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    pool_dtype = jnp.int8 if args.kv_dtype == "int8" else baseline_dtype
+    cache_dtype = "int8" if args.kv_dtype == "int8" else baseline_dtype
+    if args.t_long < 2 * (rounds + 1) * chunk:
+        raise SystemExit(f"--t-long {args.t_long} too short for "
+                         f"{rounds} rounds of {chunk}-token chunks")
+
+    cfg = GPTConfig(
+        block_size=args.t_long,
+        vocab_size=args.vocab_size,
+        n_layer=args.n_layer,
+        n_head=args.n_head,
+        n_embd=args.n_embd,
+    )
+    params = GPT.init(cfg, jax.random.PRNGKey(args.seed))
+    if on_tpu:
+        params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+
+    # The engine's own bucket rule decides the splits under test — the
+    # bench measures what serving will actually dispatch, not a hand-picked
+    # split (sampling/serve.py ServeEngine._split_bucket).
+    eng = ServeEngine(cfg, params, max_slots=1, page_size=ps,
+                      decode_chunk=chunk, temperature=0.0,
+                      cache_dtype=cache_dtype)
+    split_long = eng._split_bucket(args.t_long)
+    split_short = eng._split_bucket(args.t_short)
+    del eng
+
+    def round_ms(t_total, split_k):
+        pages = -(-t_total // ps)
+        width = 1 << max(0, pages - 1).bit_length()  # pow2 ceil
+        cache = PagedKVCache.init(cfg, 1 + width, ps, dtype=pool_dtype)
+        table = jnp.asarray(1 + np.arange(width, dtype=np.int32))[None]
+        active = jnp.ones((1,), bool)
+        tok = jnp.zeros((1,), jnp.int32)
+        lengths = t_total - (rounds + 1) * chunk
+        times = []
+        for r in range(rounds + 1):  # round 0 warms the compile
+            t0 = time.perf_counter()
+            cache, toks = _serve_decode_chunk(
+                cfg, params, tok, cache, table,
+                jnp.full((1,), lengths, jnp.int32), active,
+                chunk, 0.0, None, None, "auto", None, None, split_k,
+            )
+            tok = toks[-1]
+            float(tok.ravel()[0].astype(jnp.float32))  # force (CLAUDE.md)
+            if r:
+                times.append(time.perf_counter() - t0)
+            lengths += chunk
+        return 1000 * float(np.median(times))
+
+    ms_long_1 = round_ms(args.t_long, 1)
+    ms_long_s = round_ms(args.t_long, split_long)
+    ms_short_1 = round_ms(args.t_short, 1)
+    ms_short_4 = round_ms(args.t_short, 4)  # forced: auto stays unsplit
+
+    # Exact greedy parity, split vs unsplit, on a model with real argmax
+    # margins (the _quick_train rationale — raw-init near-ties make any
+    # f32 reduction reorder look like corruption when it is not).
+    match_bs = min(1024, args.t_long)
+    mcfg = GPTConfig(
+        block_size=match_bs,
+        vocab_size=args.vocab_size,
+        n_layer=args.n_layer,
+        n_head=args.n_head,
+        n_embd=args.n_embd,
+    )
+    mparams = GPT.init(mcfg, jax.random.PRNGKey(args.seed))
+    if on_tpu:
+        mparams = jax.tree.map(lambda p: p.astype(jnp.bfloat16), mparams)
+    mparams, train_loss = _quick_train(mcfg, mparams, args.train_steps, args.seed)
+    rng = np.random.default_rng(args.seed)
+    mtrace = [
+        (
+            rng.integers(
+                0, args.vocab_size,
+                int(rng.integers(5 * match_bs // 8, 3 * match_bs // 4)),
+                dtype=np.int64,
+            ),
+            24,
+        )
+        for _ in range(3)
+    ]
+
+    def run_match(split):
+        m_eng = ServeEngine(mcfg, mparams, max_slots=2, page_size=ps,
+                            prefill_chunk=args.prefill_chunk,
+                            decode_chunk=chunk, temperature=0.0,
+                            cache_dtype=cache_dtype, split_k=split)
+        uids = [(m_eng.submit(p, m), len(p)) for p, m in mtrace]
+        done = m_eng.run()
+        return done, uids
+
+    done_1, uids = run_match(1)
+    done_s, _ = run_match(4)
+    gmf = _greedy_match_frac(done_1, done_s, uids)
+
+    print(
+        json.dumps(
+            {
+                "bench": "serve_longctx",
+                "backend": jax.default_backend(),
+                "t_long": args.t_long,
+                "t_short": args.t_short,
+                "page_size": ps,
+                "decode_chunk": chunk,
+                "rounds": rounds,
+                "kv_dtype": args.kv_dtype,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "split_k_long": split_long,
+                "split_k_short": split_short,
+                "ms_round_long_unsplit": round(ms_long_1, 3),
+                "ms_round_long_split": round(ms_long_s, 3),
+                "long_speedup": round(ms_long_1 / ms_long_s, 3),
+                "ms_round_short_unsplit": round(ms_short_1, 3),
+                "ms_round_short_forced_split": round(ms_short_4, 3),
+                "short_ratio": round(ms_short_4 / ms_short_1, 3),
+                "match_block_size": match_bs,
+                "greedy_match_frac": round(gmf, 4),
+                "train_steps": args.train_steps,
+                "train_loss": round(train_loss, 3),
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-requests", type=int, default=12)
@@ -530,6 +699,23 @@ def main() -> int:
                     help="distinct shared system prompts in the workload")
     ap.add_argument("--template-tokens", type=int, default=0,
                     help="template length (0 = 5 * page_size)")
+    ap.add_argument("--long-ctx", action="store_true",
+                    help="long-context split-K A/B: decode-round latency of "
+                    "ONE active slot at --t-long with the engine's auto "
+                    "split vs unsplit, the same at --t-short (where auto "
+                    "stays unsplit), plus an exact greedy-parity run split "
+                    "vs unsplit on a quick-fitted model. Emits the "
+                    "'serve_longctx' JSON profile (docs/SERVING.md "
+                    "'Split-K decode')")
+    ap.add_argument("--t-long", type=int, default=4096,
+                    help="--long-ctx: long visible length (>= 1024 so the "
+                    "auto bucket rule engages a split)")
+    ap.add_argument("--t-short", type=int, default=256,
+                    help="--long-ctx: short visible length (expected to "
+                    "stay unsplit under the auto rule)")
+    ap.add_argument("--rounds", type=int, default=6,
+                    help="--long-ctx: timed decode rounds per variant "
+                    "(median reported; one extra warm round rides first)")
     args = ap.parse_args()
     if args.n_layer is None:
         args.n_layer = 6 if args.spec else 4
@@ -566,6 +752,9 @@ def main() -> int:
         params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
     baseline_dtype = jnp.bfloat16 if on_tpu else jnp.float32
     quantized = args.kv_dtype == "int8"
+    if args.long_ctx:
+        return _longctx_bench(args)
+
     train_loss = None
     if quantized and not args.spec and not args.shared_prefix_frac and not args.tp:
         # (the prefix bench skips the fit: its greedy_match_frac compares

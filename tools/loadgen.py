@@ -101,8 +101,19 @@ def _mixture(
             reqs.append((prompt, m))
             continue
         if rng.random() < long_frac:
-            t0 = int(rng.integers(block_size // 4, block_size // 2))
-            m = int(rng.integers(12, 24))
+            if block_size >= 2048:
+                # long-context regime (the split-K bucket rule's territory,
+                # sampling/serve.py `_split_bucket`): near-context document
+                # prompts with bigger output budgets, so the serve_slo line
+                # tracks p95 TPOT with auto-split decode in the mix. The
+                # small-block branch below is untouched — the default
+                # harness geometry (and its pinned program census) draws
+                # the exact same stream it always did.
+                t0 = int(rng.integers(block_size // 2, block_size * 7 // 8))
+                m = int(rng.integers(24, 48))
+            else:
+                t0 = int(rng.integers(block_size // 4, block_size // 2))
+                m = int(rng.integers(12, 24))
         else:
             t0 = int(rng.integers(4, max(5, block_size // 8)))
             m = int(rng.integers(6, 14))
@@ -219,7 +230,13 @@ def main() -> int:
     ap.add_argument("--burst-size", type=int, default=4,
                     help="--process bursty: simultaneous arrivals per burst")
     ap.add_argument("--long-frac", type=float, default=0.25,
-                    help="fraction of long-document requests in the mixture")
+                    help="fraction of long-document requests in the mixture. "
+                    "At --block-size >= 2048 the long draws move to the "
+                    "long-context regime (prompts of S/2..7S/8 tokens, "
+                    "24-48 token budgets) so p95 TPOT under mixed load "
+                    "exercises the auto split-K buckets (docs/SERVING.md "
+                    "'Split-K decode'); smaller block sizes keep the "
+                    "original S/4..S/2 draws")
     ap.add_argument("--template-frac", type=float, default=0.0,
                     help="fraction of requests sharing a template prompt "
                     "head (system-prompt traffic); pair with "
@@ -251,7 +268,10 @@ def main() -> int:
     # recompile pins (tests/test_recompile_pins.py) count compiles of the
     # 25-page f32 geometry from a pristine baseline — the in-process
     # bench-contract loadgen run must not pre-warm that program set.
-    ap.add_argument("--num-pages", type=int, default=27)
+    # 0 = auto: 27 below the long-context regime; at --block-size >= 2048
+    # a 27-page pool cannot hold ONE long-mixture prompt, so auto sizes a
+    # fully-resident pool (every slot can pin its largest bucket).
+    ap.add_argument("--num-pages", type=int, default=0)
     ap.add_argument("--max-backlog-pages", type=int, default=0,
                     help="backpressure budget (0 = unbounded)")
     ap.add_argument("--prefill-chunk", type=int, default=16)
@@ -272,6 +292,12 @@ def main() -> int:
                     "distinguishable. Pair with --cpu-devices >= N")
     args = ap.parse_args()
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    if not args.num_pages:
+        pages_per_slot = -(-args.block_size // args.page_size)
+        args.num_pages = (
+            27 if args.block_size < 2048
+            else 1 + args.max_slots * pages_per_slot
+        )
 
     import jax
 
