@@ -1,6 +1,6 @@
 """graftcheck — JAX/TPU-aware static analysis for this repo.
 
-Two passes (docs/ANALYSIS.md is the rule catalog):
+Three passes (docs/ANALYSIS.md is the rule catalog):
 
   * **Pass 1 — AST lint** (`analysis.lint`, no JAX import): walks package
     source and flags the compilation-behavior footguns that CLAUDE.md and
@@ -13,18 +13,33 @@ Two passes (docs/ANALYSIS.md is the rule catalog):
     utils/hlo.py): executable pins over post-optimization HLO and the jit
     compile cache — recompile counting, while-body collective census, fp32
     master-param presence — so the scheduling/parity claims in SERVING.md
-    and SURVEY.md §7 are tested, not remembered.
+    and SURVEY.md §7 are tested, not remembered. Its numeric budgets live
+    in `analysis.budgets`, the single manifest both the audit and
+    tests/test_recompile_pins.py consume.
+  * **Pass 3 — lifecycle/dataflow** (`analysis.lifecycle`, no JAX import):
+    interprocedural checks over the serving stack — page-ownership
+    balance on every path including exception edges (GC009), ServeEngine
+    mutation confinement to the driver-loop serialization boundary and
+    no-await-mid-mutation (GC010), and bounded-domain proofs for values
+    flowing into trailing static jit args (GC011). Same suppression
+    machinery as pass 1.
 
 `analysis.bench_contract` is the shared checker for the one-JSON-line
 driver contract that bench.py / tools/bench_serve.py (and the graftcheck
 CLI's own --json mode) must honor.
 
-CLI: `python -m midgpt_tpu.analysis [paths...] [--json] [--audit]`
-(tools/graftcheck.py is a path-setup wrapper). Pass 1 never initializes a
-JAX backend, so the lint gate is safe to run on hosts where device init is
-slow or unavailable.
+CLI: `python -m midgpt_tpu.analysis [paths...] [--json] [--audit]
+[--fail-on-new] [--update-baseline]` (tools/graftcheck.py is a path-setup
+wrapper). Passes 1 and 3 never initialize a JAX backend, so the lint gate
+is safe to run on hosts where device init is slow or unavailable;
+--fail-on-new gates CI on the committed graftcheck_baseline.json.
 """
 
+from midgpt_tpu.analysis.lifecycle import (
+    LIFECYCLE_RULES,
+    lifecycle_paths,
+    lifecycle_source,
+)
 from midgpt_tpu.analysis.lint import (
     DEFAULT_LINT_ROOTS,
     Finding,
@@ -36,7 +51,10 @@ from midgpt_tpu.analysis.lint import (
 __all__ = [
     "DEFAULT_LINT_ROOTS",
     "Finding",
+    "LIFECYCLE_RULES",
     "RULES",
+    "lifecycle_paths",
+    "lifecycle_source",
     "lint_paths",
     "lint_source",
 ]

@@ -486,6 +486,9 @@ def check_graftcheck(rec: dict) -> tp.List[str]:
             "suppressed": (int,),
             "files_scanned": (int,),
             "findings": (list,),
+            "pass3_count": (int,),
+            "pass3_suppressed": (int,),
+            "pass3_wall_ms": (int, float),
         },
         problems,
     )

@@ -1,0 +1,149 @@
+"""Declarative budget manifest for the compiled-artifact audits.
+
+Every numeric pin the serving stack promises about its lowered programs —
+how many in-loop collectives a tensor-parallel body may carry, how many
+pool-sized copies a decode loop may make (zero), what geometry the audit
+suite lowers against — lives HERE, once. Both consumers read this module:
+
+  * `analysis/hlo_audit.py run_audit()` lowers the serving programs at
+    `AUDIT` geometry and asserts each census against these budgets;
+  * `tests/test_recompile_pins.py::test_audit_suite_passes_on_cpu_mesh`
+    re-asserts the report keys against the SAME numbers.
+
+A new serving mode declares its budget by adding one entry to
+`TP_LOOP_LAYERS` (or one constant below); drift between the audit and the
+pin tests is then structurally impossible — there is no second literal to
+forget. No JAX import: the manifest must be loadable by the lint pass and
+by the tests' collection phase without touching a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditGeometry:
+    """The tiny abstract-lowering geometry the audit suite runs at.
+
+    Small enough to lower in seconds on the 1-core CI host, large enough
+    that every structural feature exists: >1 layer (so step-scan bodies
+    carry a per-layer collective multiple), >1 head (so tp=2 sharding is
+    head-aligned), a paged pool with more pages than any one request.
+    """
+
+    n_layer: int = 2
+    n_head: int = 2
+    n_embd: int = 32
+    head_dim: int = 16  # n_embd // n_head
+    block_size: int = 64
+    vocab_size: int = 128
+    num_pages: int = 9
+    page_size: int = 8
+    batch: int = 2
+    max_pages: int = 8
+    decode_chunk: int = 4
+    spec_k: int = 2
+    split_k: int = 4
+    tp: int = 2
+    draft_n_layer: int = 1
+
+
+AUDIT = AuditGeometry()
+
+# The megatron sharding contract (docs/SERVING.md "Mesh-sharded serving"):
+# one activation all-reduce after the attention output projection and one
+# after the MLP down projection — per layer, per decode step, and nothing
+# else (zero all-gather / all-to-all / reduce-scatter / collective-permute
+# in any serving loop body).
+MEGATRON_ALL_REDUCES_PER_LAYER = 2
+
+# How many transformer layers execute inside ONE while-body iteration of
+# each tp-audited program. The step-scan programs (decode, int8 decode,
+# split-K decode, int8 draft) unroll all their layers inside the body; the
+# verify program is lowered with decode_layer_scan=True so its body IS a
+# single layer. Values are AuditGeometry field names (resolved at query
+# time) or plain ints.
+TP_LOOP_LAYERS: tp.Dict[str, tp.Union[str, int]] = {
+    "tp_decode": "n_layer",
+    "tp_decode_int8": "n_layer",
+    "tp_decode_split": "n_layer",  # split-K must not move the budget
+    "tp_verify": 1,  # layer-scan body = one layer = one megatron pair
+    "tp_draft_int8": "draft_n_layer",
+}
+
+TP_PROGRAMS: tp.Tuple[str, ...] = tuple(TP_LOOP_LAYERS)
+
+# Pool/scale copy budget inside ANY serving loop body, split or not,
+# sharded or not: the KV pool aliases through the loop carry (the r5/r6
+# perf pin), so the census must find exactly zero pool-sized copies.
+LOOP_POOL_COPY_BUDGET = 0
+
+# Report keys that pin an all-zero copy census for the split-K lowerings
+# (dict-per-while-body form: every value must be 0).
+SPLIT_ZERO_COPY_KEYS: tp.Tuple[str, ...] = (
+    "split_decode_loop_pool_copies",
+    "split_verify_loop_pool_copies",
+    "split_decode_int8_loop_pool_copies",
+    "split_decode_int8_loop_scale_copies",
+)
+
+# The split-K decode body census is also collective-free; the report key
+# holds {body: n_collectives} and every value must be 0.
+SPLIT_ZERO_COLLECTIVE_KEYS: tp.Tuple[str, ...] = ("split_decode_while_bodies",)
+
+
+def tp_loop_all_reduce_budget(
+    program: str, geom: AuditGeometry = AUDIT
+) -> int:
+    """In-loop all-reduce budget for one tp-audited serving program."""
+    layers = TP_LOOP_LAYERS[program]
+    if isinstance(layers, str):
+        layers = getattr(geom, layers)
+    return MEGATRON_ALL_REDUCES_PER_LAYER * layers
+
+
+def tp_mesh_shape(geom: AuditGeometry = AUDIT) -> tp.Dict[str, int]:
+    """The serving mesh the tp audits lower against (pure tp, no data)."""
+    return {"tp": geom.tp, "data": 1}
+
+
+def pool_shape(
+    geom: AuditGeometry = AUDIT, dtype: str = "f32", tp_shards: int = 1
+) -> str:
+    """HLO shape string of one KV pool buffer (the copy-census grep key).
+
+    Layout [L, H, P, ps, D] per models/gpt.py PagedKVCache; under tensor
+    parallelism the head axis shards, so the per-shard census greps
+    H // tp_shards heads.
+    """
+    return (
+        f"{dtype}[{geom.n_layer},{geom.n_head // tp_shards},"
+        f"{geom.num_pages},{geom.page_size},{geom.head_dim}]"
+    )
+
+
+def scale_shape(
+    geom: AuditGeometry = AUDIT, tp_shards: int = 1
+) -> str:
+    """HLO shape string of an int8 pool's f32 scale side buffer.
+
+    Layout [L, P, H, ps] (page-major so the per-page quantization scales
+    gather alongside the page table).
+    """
+    return (
+        f"f32[{geom.n_layer},{geom.num_pages},"
+        f"{geom.n_head // tp_shards},{geom.page_size}]"
+    )
+
+
+def shard_pool_shapes(
+    geom: AuditGeometry = AUDIT,
+) -> tp.Tuple[str, ...]:
+    """All per-shard pool/scale shapes the tp copy census must grep."""
+    return (
+        pool_shape(geom, "f32", geom.tp),
+        pool_shape(geom, "s8", geom.tp),
+        scale_shape(geom, geom.tp),
+    )

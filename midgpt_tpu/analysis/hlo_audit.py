@@ -210,6 +210,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
     import jax
     import jax.numpy as jnp
 
+    from midgpt_tpu.analysis import budgets
     from midgpt_tpu.config import ExperimentConfig, MeshConfig
     from midgpt_tpu.models.gpt import GPT, GPTConfig, PagedKVCache
     from midgpt_tpu.parallel.mesh import make_mesh
@@ -217,7 +218,17 @@ def run_audit() -> tp.Dict[str, tp.Any]:
 
     report: tp.Dict[str, tp.Any] = {"backend": jax.default_backend()}
 
-    mc = GPTConfig(block_size=64, vocab_size=128, n_layer=2, n_head=2, n_embd=32)
+    # All geometry and numeric budgets come from the declarative manifest
+    # (analysis/budgets.py) — the same source tests/test_recompile_pins.py
+    # asserts the report against, so audit and pins cannot drift.
+    g = budgets.AUDIT
+    mc = GPTConfig(
+        block_size=g.block_size,
+        vocab_size=g.vocab_size,
+        n_layer=g.n_layer,
+        n_head=g.n_head,
+        n_embd=g.n_embd,
+    )
     cfg = ExperimentConfig(
         rundir="",
         data_dir="",
@@ -250,9 +261,11 @@ def run_audit() -> tp.Dict[str, tp.Any]:
 
     params_abs = jax.eval_shape(lambda k: GPT.init(mc, k), jax.random.PRNGKey(0))
     cache_abs = jax.eval_shape(
-        lambda: PagedKVCache.init(mc, num_pages=9, page_size=8, dtype=jnp.float32)
+        lambda: PagedKVCache.init(
+            mc, num_pages=g.num_pages, page_size=g.page_size, dtype=jnp.float32
+        )
     )
-    B, max_pages = 2, 8
+    B, max_pages = g.batch, g.max_pages
     decode_hlo = (
         _serve_decode_chunk.lower(
             mc,
@@ -262,7 +275,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.bool_),
-            4,
+            g.decode_chunk,
             0.0,
             None,
             None,
@@ -281,7 +294,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
     # decode loop's carry (the r5/r6 perf pin held by tests/test_sampling.py
     # on bigger shapes), here audited on the same artifact the collective
     # census reads.
-    pool_shape = f"f32[{mc.n_layer},{mc.n_head},9,8,{mc.head_dim}]"
+    pool_shape = budgets.pool_shape(g)
     copies = while_body_pool_copies(decode_hlo, pool_shape)
     report["decode_loop_pool_copies"] = {b: len(ls) for b, ls in copies.items()}
     assert all(not ls for ls in copies.values()), (
@@ -299,7 +312,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
     from midgpt_tpu.sampling.serve import _spec_verify_chunk
 
     mc_scan = dataclasses.replace(mc, decode_layer_scan=True)
-    K = 2
+    K = g.spec_k
     verify_hlo = (
         _spec_verify_chunk.lower(
             mc_scan,
@@ -341,10 +354,12 @@ def run_audit() -> tp.Dict[str, tp.Any]:
     from midgpt_tpu.sampling.serve import _spec_draft_chunk
 
     cache8_abs = jax.eval_shape(
-        lambda: PagedKVCache.init(mc, num_pages=9, page_size=8, dtype=jnp.int8)
+        lambda: PagedKVCache.init(
+            mc, num_pages=g.num_pages, page_size=g.page_size, dtype=jnp.int8
+        )
     )
-    pool8_shape = f"s8[{mc.n_layer},{mc.n_head},9,8,{mc.head_dim}]"
-    scale_shape = f"f32[{mc.n_layer},9,{mc.n_head},8]"
+    pool8_shape = budgets.pool_shape(g, "s8")
+    scale_shape = budgets.scale_shape(g)
     decode8_hlo = (
         _serve_decode_chunk.lower(
             mc,
@@ -354,7 +369,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.bool_),
-            4,
+            g.decode_chunk,
             0.0,
             None,
             None,
@@ -364,7 +379,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
         .compile()
         .as_text()
     )
-    draft_cfg = dataclasses.replace(mc, n_layer=1)
+    draft_cfg = dataclasses.replace(mc, n_layer=g.draft_n_layer)
     draft_abs = jax.eval_shape(
         lambda k: GPT.init(draft_cfg, k), jax.random.PRNGKey(0)
     )
@@ -446,14 +461,14 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.bool_),
-            4,
+            g.decode_chunk,
             0.0,
             None,
             None,
             "gather",
             None,
             None,
-            4,
+            g.split_k,
         )
         .compile()
         .as_text()
@@ -488,7 +503,7 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             "gather",
             None,
             None,
-            4,
+            g.split_k,
         )
         .compile()
         .as_text()
@@ -512,14 +527,14 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.int32),
             jax.ShapeDtypeStruct((B,), jnp.bool_),
-            4,
+            g.decode_chunk,
             0.0,
             None,
             None,
             "gather",
             None,
             None,
-            4,
+            g.split_k,
         )
         .compile()
         .as_text()
@@ -558,9 +573,8 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             serve_param_specs,
         )
 
-        smesh = make_serve_mesh(tp_size=2)
-        n_tp = 2
-        report["tp_mesh"] = {"tp": n_tp, "data": 1}
+        smesh = make_serve_mesh(tp_size=g.tp)
+        report["tp_mesh"] = budgets.tp_mesh_shape(g)
         # head-aligned qkv shards need the split3 einsum order — the same
         # config switch ServeEngine(mesh=...) makes (training/train.py)
         mc3 = dataclasses.replace(mc, qkv_proj="split3")
@@ -587,46 +601,38 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             return _serve_decode_chunk.lower(
                 cfg, params_tp, sds((B,), i32), cache,
                 sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
-                4, 0.0, None, None, "gather", None, smesh, split_k,
+                g.decode_chunk, 0.0, None, None, "gather", None, smesh,
+                split_k,
             ).compile().as_text()
 
-        tp_programs = {
-            "tp_decode": (_decode_lower(mc3, cache_tp), 2 * mc.n_layer),
-            "tp_decode_int8": (_decode_lower(mc3, cache8_tp), 2 * mc.n_layer),
+        # One lowering per budgets.TP_PROGRAMS entry; the per-program
+        # all-reduce budget comes from the manifest, not from literals here.
+        tp_lowered = {
+            "tp_decode": _decode_lower(mc3, cache_tp),
+            "tp_decode_int8": _decode_lower(mc3, cache8_tp),
             # split-K under tp: the partition scan rides INSIDE each head
             # shard — the all-reduce budget must not move by a single op
-            "tp_decode_split": (
-                _decode_lower(mc3, cache_tp, split_k=4),
-                2 * mc.n_layer,
-            ),
-            "tp_verify": (
-                _spec_verify_chunk.lower(
-                    mc3_scan, params_tp, sds((B,), i32), sds((K, B), i32),
-                    sds((K, B, mc.vocab_size), jnp.float32), cache_tp,
-                    sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
-                    0.0, None, None, "gather", None, smesh,
-                ).compile().as_text(),
-                2,  # layer-scan body = one layer = one megatron pair
-            ),
-            "tp_draft_int8": (
-                _spec_draft_chunk.lower(
-                    draft3_cfg, draft_tp, sds((B,), i32), cache8_tp,
-                    sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
-                    K, 0.0, None, None, "gather", None, smesh,
-                ).compile().as_text(),
-                2 * draft_cfg.n_layer,
-            ),
+            "tp_decode_split": _decode_lower(mc3, cache_tp, split_k=g.split_k),
+            "tp_verify": _spec_verify_chunk.lower(
+                mc3_scan, params_tp, sds((B,), i32), sds((K, B), i32),
+                sds((K, B, mc.vocab_size), jnp.float32), cache_tp,
+                sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
+                0.0, None, None, "gather", None, smesh,
+            ).compile().as_text(),
+            "tp_draft_int8": _spec_draft_chunk.lower(
+                draft3_cfg, draft_tp, sds((B,), i32), cache8_tp,
+                sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
+                K, 0.0, None, None, "gather", None, smesh,
+            ).compile().as_text(),
         }
+        assert set(tp_lowered) == set(budgets.TP_PROGRAMS)
         # per-SHARD pool shapes: H/tp heads per shard (head axis 1 of the
         # pools, axis 2 of the scale side buffers)
-        h_shard = mc.n_head // n_tp
-        shard_shapes = (
-            f"f32[{mc.n_layer},{h_shard},9,8,{mc.head_dim}]",
-            f"s8[{mc.n_layer},{h_shard},9,8,{mc.head_dim}]",
-            f"f32[{mc.n_layer},9,{h_shard},8]",
-        )
+        shard_shapes = budgets.shard_pool_shapes(g)
         other_ops = tuple(o for o in COLLECTIVE_OPS if o != "all-reduce")
-        for name, (hlo, budget) in tp_programs.items():
+        for name in budgets.TP_PROGRAMS:
+            hlo = tp_lowered[name]
+            budget = budgets.tp_loop_all_reduce_budget(name, g)
             assert_no_while_body_collectives(hlo, ops=other_ops)
             ar = while_body_collectives(hlo, ops=("all-reduce",))
             n_ar = sum(len(ls) for ls in ar.values())
@@ -638,9 +644,9 @@ def run_audit() -> tp.Dict[str, tp.Any]:
             for shape in shard_shapes:
                 copies = while_body_pool_copies(hlo, shape)
                 n_cp = sum(len(ls) for ls in copies.values())
-                assert n_cp == 0, (
+                assert n_cp == budgets.LOOP_POOL_COPY_BUDGET, (
                     f"{name}: {n_cp} in-loop {shape} pool/scale copies — "
                     "the sharded pool must alias through the loop carry"
                 )
-            report[f"{name}_loop_pool_copies"] = 0
+            report[f"{name}_loop_pool_copies"] = budgets.LOOP_POOL_COPY_BUDGET
     return report

@@ -332,6 +332,24 @@ def test_bench_train_emits_conformant_json_line(capsys):
     assert rec["detail"]["seq_len"] == 64 and rec["detail"]["n_devices"] == 8
 
 
+def test_graftcheck_cli_emits_conformant_json_line(capsys, tmp_path):
+    """tools/graftcheck.py --json through the SAME in-process harness as
+    the benches: its line must satisfy the graftcheck profile, including
+    the pass-3 stats fields."""
+    p = tmp_path / "clean.py"
+    p.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x + 1\n")
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "graftcheck.py"),
+        ["graftcheck.py", "--json", str(p)],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "graftcheck")
+    assert not problems, problems
+    assert rec["tool"] == "graftcheck"
+    assert rec["count"] == 0 and rec["files_scanned"] == 1
+    assert rec["pass3_count"] == 0 and rec["pass3_wall_ms"] >= 0
+
+
 # ----------------------------------------------------------------------
 # checker unit behavior (no bench run needed)
 # ----------------------------------------------------------------------

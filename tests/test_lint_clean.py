@@ -6,9 +6,11 @@ clock, or an uncited parity claim fails HERE with a rule ID and file:line
 — and any suppression added to get past it must carry a justification.
 """
 
+import json
 import os
 
-from midgpt_tpu.analysis.__main__ import _default_paths
+from midgpt_tpu.analysis.__main__ import BASELINE_PATH, _default_paths
+from midgpt_tpu.analysis.lifecycle import lifecycle_paths
 from midgpt_tpu.analysis.lint import iter_python_files, lint_paths, parse_suppressions
 
 
@@ -16,6 +18,22 @@ def test_tree_is_violation_free():
     active, _suppressed, n_files = lint_paths(_default_paths())
     assert n_files > 50, "lint roots resolved to almost nothing — path bug?"
     assert active == [], "\n" + "\n".join(f.format() for f in active)
+
+
+def test_tree_is_lifecycle_clean():
+    """Pass 3 (GC009/GC010/GC011) on the whole tree: zero unsuppressed
+    findings. A page-lifecycle leak, an engine touch from the event loop,
+    or an unbounded static-arg domain fails here with file:line."""
+    active, _suppressed, n_files = lifecycle_paths(_default_paths())
+    assert n_files > 50, "lifecycle roots resolved to almost nothing — path bug?"
+    assert active == [], "\n" + "\n".join(f.format() for f in active)
+
+
+def test_baseline_matches_clean_tree():
+    """The committed --fail-on-new baseline must be empty while the tree is
+    clean; a stale non-empty baseline would mask reintroduced findings."""
+    with open(BASELINE_PATH, encoding="utf-8") as fh:
+        assert json.load(fh) == []
 
 
 def test_every_suppression_is_justified():

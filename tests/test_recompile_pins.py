@@ -299,7 +299,14 @@ def test_train_step_compiles_exactly_once():
 def test_audit_suite_passes_on_cpu_mesh():
     """run_audit = what `python -m midgpt_tpu.analysis --audit` executes:
     fp32 master params + bf16 compute on the lowered train step, and a
-    collective-free decode while body. Raises on violation."""
+    collective-free decode while body. Raises on violation.
+
+    Every numeric budget asserted here is read from the declarative
+    manifest (analysis/budgets.py) — the same module run_audit lowers
+    against — so this pin and the audit cannot drift apart; the manifest
+    is the single place a serving mode's budget is declared."""
+    from midgpt_tpu.analysis import budgets
+
     report = run_audit()
     fp = report["train_step_fp32_master"]
     assert fp["n_reduced"] == 0 and fp["n_f32"] > 0 and fp["has_bf16_compute"]
@@ -309,33 +316,26 @@ def test_audit_suite_passes_on_cpu_mesh():
     # zero-in-loop-cache-copy census on BOTH serving programs
     assert report["verify_while_bodies"], "verify program lost its layer scan?"
     assert all(n == 0 for n in report["verify_while_bodies"].values())
-    assert all(n == 0 for n in report["decode_loop_pool_copies"].values())
-    assert all(n == 0 for n in report["verify_loop_pool_copies"].values())
+    zero = budgets.LOOP_POOL_COPY_BUDGET
+    assert all(n == zero for n in report["decode_loop_pool_copies"].values())
+    assert all(n == zero for n in report["verify_loop_pool_copies"].values())
     # mesh-sharded serving extensions: per-program in-loop collective
-    # census on the tp=2 lowerings — exactly the two megatron activation
-    # all-reduces per layer per step (2*n_layer for the step-scan decode/
-    # draft bodies, 2 for the layer-scan verify body), no other collective
-    # op anywhere in a loop, and zero per-shard pool/scale copies
-    assert report["tp_mesh"] == {"tp": 2, "data": 1}
-    assert report["tp_decode_loop_all_reduces"] == 4
-    assert report["tp_decode_int8_loop_all_reduces"] == 4
-    assert report["tp_verify_loop_all_reduces"] == 2
-    assert report["tp_draft_int8_loop_all_reduces"] == 2
-    for name in ("tp_decode", "tp_decode_int8", "tp_verify", "tp_draft_int8"):
-        assert report[f"{name}_loop_pool_copies"] == 0
+    # census on the tp lowerings — exactly the megatron activation
+    # all-reduce budget the manifest declares per program, no other
+    # collective op anywhere in a loop, and zero per-shard pool/scale
+    # copies
+    assert report["tp_mesh"] == budgets.tp_mesh_shape()
+    for name in budgets.TP_PROGRAMS:
+        assert (
+            report[f"{name}_loop_all_reduces"]
+            == budgets.tp_loop_all_reduce_budget(name)
+        ), name
+        assert report[f"{name}_loop_pool_copies"] == zero, name
     # split-K extensions: sequence partitioning is a softmax-statistics
-    # restructure, so the split_k=4 lowerings must add ZERO pool traffic
-    # (no pool- or scale-sized copy in any decode/verify loop) and zero
-    # collectives beyond the same 2*n_layer megatron all-reduces the
-    # unsplit tp program carries
+    # restructure, so the split lowerings must add ZERO pool traffic (no
+    # pool- or scale-sized copy in any decode/verify loop) and zero
+    # collectives beyond the megatron all-reduces the unsplit tp program
+    # carries (tp_decode_split is asserted with the rest of TP_PROGRAMS)
     assert report["split_decode_while_bodies"], "split decode lost its scan?"
-    for key in (
-        "split_decode_while_bodies",
-        "split_decode_loop_pool_copies",
-        "split_verify_loop_pool_copies",
-        "split_decode_int8_loop_pool_copies",
-        "split_decode_int8_loop_scale_copies",
-    ):
-        assert all(n == 0 for n in report[key].values()), key
-    assert report["tp_decode_split_loop_all_reduces"] == 4
-    assert report["tp_decode_split_loop_pool_copies"] == 0
+    for key in budgets.SPLIT_ZERO_COLLECTIVE_KEYS + budgets.SPLIT_ZERO_COPY_KEYS:
+        assert all(n == zero for n in report[key].values()), key
