@@ -78,6 +78,23 @@ def check_train_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def _require_round_decomp(rec: dict, problems: tp.List[str]) -> None:
+    """round_host_ms / round_device_ms: the decode-round split the flight
+    recorder measures (docs/OBSERVABILITY.md). Each is {p50, p95} in ms,
+    finite (NaN already rejected at parse) and non-negative."""
+    for key in ("round_host_ms", "round_device_ms"):
+        d = rec.get(key)
+        if not isinstance(d, dict):
+            problems.append(f"field {key!r} must be an object with p50/p95")
+            continue
+        for q in ("p50", "p95"):
+            v = d.get(q)
+            if not isinstance(v, Number) or isinstance(v, bool):
+                problems.append(f"field {key!r}.{q} must be a number")
+            elif v < 0:
+                problems.append(f"{key}.{q} {v} < 0")
+
+
 def check_serve_bench(rec: dict) -> tp.List[str]:
     """tools/bench_serve.py profile (field table: docs/SERVING.md)."""
     problems: tp.List[str] = []
@@ -98,6 +115,7 @@ def check_serve_bench(rec: dict) -> tp.List[str]:
             "ttft_ms_p95": Number,
             "req_tok_s_p50": Number,
             "req_tok_s_p95": Number,
+            "decode_rounds": (int,),
             "kv_dtype": (str,),
             "num_pages": (int,),
             "preemptions": (int,),
@@ -111,6 +129,7 @@ def check_serve_bench(rec: dict) -> tp.List[str]:
     )
     if rec.get("bench") != "serve":
         problems.append(f"field 'bench' is {rec.get('bench')!r}, expected 'serve'")
+    _require_round_decomp(rec, problems)
     if rec.get("kv_dtype") not in (None, "bf16", "int8"):
         problems.append(f"field 'kv_dtype' is {rec.get('kv_dtype')!r}")
     if "device_peak_bytes_in_use" not in rec:
@@ -430,6 +449,7 @@ def check_serve_slo_bench(rec: dict) -> tp.List[str]:
         problems.append(
             f"field 'bench' is {rec.get('bench')!r}, expected 'serve_slo'"
         )
+    _require_round_decomp(rec, problems)
     if rec.get("process") not in (None, "poisson", "bursty"):
         problems.append(f"field 'process' is {rec.get('process')!r}")
     if "slo_ok" not in rec or not isinstance(rec["slo_ok"], bool):
@@ -460,9 +480,11 @@ def check_serve_slo_bench(rec: dict) -> tp.List[str]:
                     "ttft_p95_ms": Number,
                     "tpot_p50_ms": Number,
                     "tpot_p95_ms": Number,
+                    "rounds": (int,),
                 },
                 pp,
             )
+            _require_round_decomp(p, pp)
             problems.extend(f"points[{i}]: {q}" for q in pp)
             # optional: present when loadgen ran with --prefix-cache
             for frac in ("shed_frac", "timeout_frac", "prefix_hit_rate"):

@@ -21,6 +21,14 @@ the CLAUDE.md / RESULTS.md citations live in docs/ANALYSIS.md):
          toward zero, so float values quantized that way lose up to a full
          step of precision and bias toward 0 — quantization must round
          (ops/quant.py quantize_q8 is the blessed path; int8 KV cache PR).
+  GC012  bare wall-clock CALL (`time.time()` / `time.perf_counter()` /
+         `time.monotonic()` ...) in a `sampling/` or `robustness/` module:
+         those hot paths measure latency through the injectable clock
+         (`clock=` ctor param threaded to `self._clock`), which is what
+         keeps round decomposition tunnel-consistent and lets tests fake
+         time. Default-arg REFERENCES (`clock=time.perf_counter`) are the
+         plumbing itself, not a read — only Call nodes are flagged, and
+         `time.sleep()` is not a clock read (observability PR).
 
 Scope model: a function is *traced* if it is jit-decorated (including
 `functools.partial(jax.jit, ...)` and `name = jax.jit(fn)` rebinding), a
@@ -55,6 +63,7 @@ RULES: tp.Dict[str, str] = {
     "GC006": "parity claim without a reference or pinning-test citation",
     "GC007": "swallowed exception around a checkpoint/collective call site",
     "GC008": "truncating .astype(int8) cast — quantization must round",
+    "GC012": "bare wall-clock call in a serving/robustness hot path",
 }
 
 # Default lint roots, relative to the repo root (tests are excluded on
@@ -719,6 +728,58 @@ def _rule_gc008(mod: _Module) -> tp.Iterator[Finding]:
             )
 
 
+# Wall-clock reads GC012 recognizes. `sleep` is absent on purpose (a delay,
+# not a measurement) and so are the *_ns variants' non-time roots — only
+# calls rooted at the `time` module count.
+_GC012_CLOCK_LEAVES = frozenset(
+    {
+        "time",
+        "perf_counter",
+        "monotonic",
+        "process_time",
+        "time_ns",
+        "perf_counter_ns",
+        "monotonic_ns",
+        "process_time_ns",
+    }
+)
+
+
+def _gc012_in_scope(path: str) -> bool:
+    """Path-scoped: only `sampling/` and `robustness/` trees — the hot
+    paths where the injectable-clock discipline is load-bearing."""
+    parts = re.split(r"[/\\]", path)
+    return "sampling" in parts or "robustness" in parts
+
+
+def _rule_gc012(mod: _Module) -> tp.Iterator[Finding]:
+    """Bare clock CALLS in injectable-clock territory. A reference like
+    `clock=time.perf_counter` (ctor default) is the plumbing itself and is
+    a Name/Attribute node, not a Call — never flagged."""
+    if not _gc012_in_scope(mod.path):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if not name or "." not in name:
+            continue
+        parts = name.split(".")
+        if parts[0] == "time" and parts[-1] in _GC012_CLOCK_LEAVES:
+            yield Finding(
+                "GC012",
+                mod.path,
+                node.lineno,
+                node.col_offset,
+                f"`{name}()` bypasses the injected clock in a serving/"
+                "robustness hot path — read `self._clock()` (or the "
+                "module's `clock` parameter) so tests can fake time and "
+                "round decomposition stays tunnel-consistent "
+                "(docs/OBSERVABILITY.md); suppress with justification "
+                "for genuinely wall-anchored timestamps",
+            )
+
+
 _ALL_RULES = (
     _rule_gc001,
     _rule_gc002,
@@ -728,6 +789,7 @@ _ALL_RULES = (
     _rule_gc006,
     _rule_gc007,
     _rule_gc008,
+    _rule_gc012,
 )
 
 

@@ -34,6 +34,7 @@ import typing as tp
 
 import numpy as np
 
+from midgpt_tpu.obs import Observability
 from midgpt_tpu.robustness import faults
 
 # Storm burst: how many clone requests the submit_storm fault slams into
@@ -84,7 +85,9 @@ def _trace(cfg, seed: int, n_requests: int, shared: bool = False):
     return out
 
 
-def _engine(cfg, params, *, max_backlog_pages=None, clock=None, prefix=False):
+def _engine(
+    cfg, params, *, max_backlog_pages=None, clock=None, prefix=False, obs=None
+):
     import jax.numpy as jnp
 
     from midgpt_tpu.sampling.serve import ServeEngine
@@ -92,6 +95,8 @@ def _engine(cfg, params, *, max_backlog_pages=None, clock=None, prefix=False):
     kw: tp.Dict[str, tp.Any] = {}
     if clock is not None:
         kw["clock"] = clock
+    if obs is not None:
+        kw["obs"] = obs
     return ServeEngine(
         cfg,
         params,
@@ -171,11 +176,17 @@ def _run_server(eng, trace):
 
 
 def run_serving_chaos(
-    fault_plan: str, *, seed: int = 0, n_requests: int = 5
+    fault_plan: str, *, seed: int = 0, n_requests: int = 5,
+    trace_dir: tp.Optional[str] = None,
 ) -> tp.Dict[str, tp.Any]:
     """Run the scenario (module docstring); returns the summary dict that
     `chaos_run.py --serve` emits as its JSON line. Raises AssertionError
-    when a degradation invariant breaks — that IS the chaos verdict."""
+    when a degradation invariant breaks — that IS the chaos verdict.
+
+    With `trace_dir`, the fault pass runs under a flight recorder
+    (midgpt_tpu/obs/) and dumps it there as a Chrome trace
+    (`flight_recorder.json` + `.prom` metrics) — the serving postmortem
+    artifact, written even when an invariant assertion fails."""
     cfg, params = _tiny_model(seed)
     uses_server = "slow_client" in fault_plan
     uses_storm = "submit_storm" in fault_plan
@@ -197,17 +208,25 @@ def run_serving_chaos(
 
     faults.clear()
     armed = faults.activate_plan(fault_plan)
+    # Only the FAULT pass is recorded: the reference pass must stay the
+    # untouched parity baseline, and the postmortem reader wants the trace
+    # of the run that went wrong, not the rehearsal.
+    obs = None if trace_dir is None else Observability()
     eng = _engine(
         cfg, params,
         max_backlog_pages=STORM_BACKLOG_PAGES if uses_storm else None,
         prefix=uses_prefix,
+        obs=obs,
     )
     delivered: tp.Optional[tp.Dict[int, tp.List[int]]] = None
     storm_shed = 0
-    if uses_server:
-        uid_to_idx, delivered = _run_server(eng, trace)
-    else:
-        uid_to_idx, storm_shed = _run_plain(eng, trace, storm=uses_storm)
+    try:
+        if uses_server:
+            uid_to_idx, delivered = _run_server(eng, trace)
+        else:
+            uid_to_idx, storm_shed = _run_plain(eng, trace, storm=uses_storm)
+    finally:
+        trace_path = None if obs is None else obs.dump(trace_dir)
     fired = faults.fired_counts()
     faults.clear()
 
@@ -277,4 +296,5 @@ def run_serving_chaos(
         "prefix_cache": eng.prefix_cache is not None,
         "prefix_reclaimed": eng.prefix_evictions,
         "prefix_hit_rate": eng.prefix_stats()["hit_rate"],
+        "trace": trace_path,
     }

@@ -36,6 +36,7 @@ import time
 import typing as tp
 
 from midgpt_tpu.config import ExperimentConfig
+from midgpt_tpu.obs import dump_flight_recorder, flight_recorder
 from midgpt_tpu.robustness import faults
 from midgpt_tpu.robustness.errors import DivergenceError
 from midgpt_tpu.training.train import TrainRuntime, make_runtime, train
@@ -118,6 +119,12 @@ def supervise(
             }
             return result
         except DivergenceError as e:
+            # Postmortem artifact FIRST, before any re-raise path: the
+            # flight recorder's tail (train.step spans, ckpt events, the
+            # train.divergence instant) as a loadable Chrome trace
+            # (docs/OBSERVABILITY.md "Crash dumps").
+            if config.rundir and not config.rundir.startswith("gs://"):
+                dump_flight_recorder(config.rundir)
             if e.last_good_step is None:
                 raise RuntimeError(
                     f"training diverged at step {e.step} with NO verified "
@@ -141,6 +148,15 @@ def supervise(
             windows.append([lo, hi])
             restarts += 1
             offset += max(1, e.step - e.last_good_step)
+            flight_recorder().tracer.instant(
+                "supervisor.rollback", "supervisor", "train",
+                args={
+                    "step": e.step,
+                    "last_good_step": e.last_good_step,
+                    "window": [lo, hi],
+                    "restart": restarts,
+                },
+            )
             _save_state(
                 config.rundir,
                 {

@@ -55,6 +55,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from midgpt_tpu.models.gpt import GPTConfig, GPTParams, PagedKVCache
+from midgpt_tpu.obs import DISABLED_SNAPSHOT, Observability
+from midgpt_tpu.obs.trace import NULL_TRACER
 from midgpt_tpu.sampling.serve import (
     BackpressureError,
     FinishedRequest,
@@ -173,6 +175,7 @@ class DisaggServe:
         prefill_kw: tp.Optional[tp.Dict[str, tp.Any]] = None,
         decode_kw: tp.Optional[tp.Dict[str, tp.Any]] = None,
         clock: tp.Callable[[], float] = time.perf_counter,
+        obs: tp.Optional[Observability] = None,
         **engine_kw,
     ):
         if engine_kw.get("temperature", 0.0) != 0.0:
@@ -195,12 +198,20 @@ class DisaggServe:
                 )
             pf_mesh, dec_mesh = roles[0], roles[1]
         self._clock = clock
+        # One shared Observability, two tid lanes: both roles' round spans
+        # land in the same flight recorder under "prefill"/"decode" thread
+        # names, with the handoff spans on a third "disagg" lane — the
+        # Perfetto view IS the pipeline diagram.
+        self.obs = obs
+        self._trace = obs.tracer if obs is not None else NULL_TRACER
         self.prefill = ServeEngine(
             config, params, prefix_cache=True, clock=clock, mesh=pf_mesh,
+            obs=obs, obs_tid="prefill",
             **{**engine_kw, **(prefill_kw or {})},
         )
         self.decode = ServeEngine(
             config, params, prefix_cache=True, clock=clock, mesh=dec_mesh,
+            obs=obs, obs_tid="decode",
             **{**engine_kw, **(decode_kw or {})},
         )
         self.queue = PageHandoffQueue()
@@ -272,6 +283,10 @@ class DisaggServe:
             "fallback_reprefills": self.fallback_reprefills,
             "prefill": self.prefill.stats(),
             "decode": self.decode.stats(),
+            # shared across both roles (one Observability, two tid lanes)
+            "obs": (
+                DISABLED_SNAPSHOT if self.obs is None else self.obs.snapshot()
+            ),
         }
 
     # -- internals -----------------------------------------------------
@@ -301,9 +316,18 @@ class DisaggServe:
                     )
                 )
                 continue
-            self.queue.push(self._gather_pages(
+            item = self._gather_pages(
                 uid, prompt, first, first_time, max_new, eos_id, deadline
-            ))
+            )
+            self.queue.push(item)
+            self._trace.instant(
+                "handoff.push", "disagg", "disagg",
+                args={
+                    "uid": uid,
+                    "n_pages": item.n_pages,
+                    "bytes": sum(b.nbytes for b in item.blocks.values()),
+                },
+            )
 
     def _gather_pages(
         self, uid, prompt, first, first_time, max_new, eos_id, deadline
@@ -312,26 +336,27 @@ class DisaggServe:
         prefill trie, land their content on the host, and drop the refs
         (the entries stay in the PREFILL trie for future shared-template
         hits — the handoff copies, it does not steal)."""
-        pc = self.prefill.prefix_cache
-        mr = pc.match(prompt, max_tokens=len(prompt) - 1)
-        n = len(mr.pages)
-        blocks: tp.Dict[str, np.ndarray] = {}
-        if n:
-            idx = jnp.asarray(mr.pages, jnp.int32)
-            cache = self.prefill.cache
-            blocks["k"] = np.asarray(jnp.take(cache.k, idx, axis=2))
-            blocks["v"] = np.asarray(jnp.take(cache.v, idx, axis=2))
-            if cache.k_scale is not None:
-                blocks["k_scale"] = np.asarray(
-                    jnp.take(cache.k_scale, idx, axis=1)
+        with self._trace.span("handoff.gather", "disagg", "disagg"):
+            pc = self.prefill.prefix_cache
+            mr = pc.match(prompt, max_tokens=len(prompt) - 1)
+            n = len(mr.pages)
+            blocks: tp.Dict[str, np.ndarray] = {}
+            if n:
+                idx = jnp.asarray(mr.pages, jnp.int32)
+                cache = self.prefill.cache
+                blocks["k"] = np.asarray(jnp.take(cache.k, idx, axis=2))
+                blocks["v"] = np.asarray(jnp.take(cache.v, idx, axis=2))
+                if cache.k_scale is not None:
+                    blocks["k_scale"] = np.asarray(
+                        jnp.take(cache.k_scale, idx, axis=1)
+                    )
+                    blocks["v_scale"] = np.asarray(
+                        jnp.take(cache.v_scale, idx, axis=1)
+                    )
+                ps = self.prefill.page_size
+                self.prefill.allocator.free(
+                    pc.release(prompt[: n * ps], mr.pages, n)
                 )
-                blocks["v_scale"] = np.asarray(
-                    jnp.take(cache.v_scale, idx, axis=1)
-                )
-            ps = self.prefill.page_size
-            self.prefill.allocator.free(
-                pc.release(prompt[: n * ps], mr.pages, n)
-            )
         return HandoffItem(
             uid=uid, prompt=prompt, first_token=first, first_time=first_time,
             max_new_tokens=max_new, eos_id=eos_id, deadline=deadline,
@@ -364,7 +389,8 @@ class DisaggServe:
             except BackpressureError:
                 self.queue.requeue(item)
                 break  # decode role is full; retry next tick
-            self._adopt(item)
+            with self._trace.span("handoff.adopt", "disagg", "disagg"):
+                self._adopt(item)
             self._dec_pending[dec_uid] = item
 
     def _adopt(self, item: HandoffItem) -> None:
@@ -387,6 +413,10 @@ class DisaggServe:
             dst = eng.allocator.alloc(n)
         if dst is None:
             self.fallback_reprefills += 1
+            self._trace.instant(
+                "handoff.fallback_reprefill", "disagg", "disagg",
+                args={"uid": item.uid},
+            )
             return
         bucket = 1
         while bucket < n:

@@ -116,6 +116,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from midgpt_tpu.models.gpt import GPT, GPTConfig, GPTParams, PagedKVCache
+from midgpt_tpu.obs import DISABLED_SNAPSHOT, Observability
+from midgpt_tpu.obs.trace import NULL_TRACER
 from midgpt_tpu.robustness import faults
 from midgpt_tpu.sampling.engine import sample_logits, warp_logits
 from midgpt_tpu.sampling.prefix_cache import PrefixCache
@@ -471,6 +473,8 @@ class ServeEngine:
         on_token: tp.Optional[tp.Callable[[int, int, float], None]] = None,
         on_finish: tp.Optional[tp.Callable[["FinishedRequest"], None]] = None,
         mesh=None,  # Optional[jax.sharding.Mesh] — parallel/serve_tp.py
+        obs: tp.Optional[Observability] = None,
+        obs_tid: str = "engine",
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
         # ---- tp serving mesh (docs/SERVING.md "Mesh-sharded serving") ----
@@ -515,6 +519,16 @@ class ServeEngine:
         self.params = params
         self.scheduler = scheduler if scheduler is not None else FCFSScheduler()
         self._clock = clock
+        # Observability (midgpt_tpu/obs/): spans + round decomposition +
+        # metrics, all host-side. obs=None keeps NULL_TRACER in every
+        # instrumentation site — zero clock reads, zero ring appends —
+        # and the scheduling/token path is bit-identical either way
+        # (tests/test_obs.py pins parity; tests/test_recompile_pins.py
+        # pins that the toggle compiles nothing: spans never cross the
+        # jit boundary, so no static, no program).
+        self.obs = obs
+        self._trace = obs.tracer if obs is not None else NULL_TRACER
+        self._obs_tid = obs_tid
         self.on_token = on_token
         self.on_finish = on_finish
         self.page_size = page_size
@@ -708,6 +722,10 @@ class ServeEngine:
         if shed is not None:
             message, retryable = shed
             self.shed += 1
+            self._trace.instant(
+                "shed", "lifecycle", self._obs_tid,
+                args={"needed_pages": need, "retryable": retryable},
+            )
             raise BackpressureError(
                 message,
                 needed_pages=need,
@@ -856,6 +874,12 @@ class ServeEngine:
             "shed": self.shed,
             "cancelled": self.cancelled,
             "compile_counts": self.compile_stats(),
+            # unified observability schema (docs/OBSERVABILITY.md): round
+            # decomposition + metrics when an Observability is wired in,
+            # {"enabled": False} otherwise — consumers key on the flag.
+            "obs": (
+                DISABLED_SNAPSHOT if self.obs is None else self.obs.snapshot()
+            ),
         }
 
     # -- scheduling round ----------------------------------------------
@@ -870,19 +894,32 @@ class ServeEngine:
         deterministic for a seeded trace (`kill_mid_decode@7` always
         strikes round 7)."""
         self.rounds += 1
+        tr = self._trace
+        t_round = 0.0 if self.obs is None else self._clock()
         if faults.should_fire("poisoned_page", step=self.rounds):
+            tr.instant("fault.poisoned_page", "fault", self._obs_tid)
             self._poison_page()
         if faults.should_fire("evict_shared_prefix", step=self.rounds):
+            tr.instant("fault.evict_shared_prefix", "fault", self._obs_tid)
             self._evict_shared_prefix_fault()
-        self._expire_round()
-        self._admit()
-        self._prefill_round()
+        with tr.span("engine.expire", "phase", self._obs_tid):
+            self._expire_round()
+        with tr.span("engine.admit", "phase", self._obs_tid):
+            self._admit()
+        with tr.span("engine.prefill", "phase", self._obs_tid):
+            self._prefill_round()
         if faults.should_fire("kill_mid_decode", step=self.rounds):
+            tr.instant("fault.kill_mid_decode", "fault", self._obs_tid)
             self._kill_decode_round()
         elif self.draft_params is not None:
             self._spec_round()
         else:
             self._decode_round()
+        if self.obs is not None:
+            tr.complete(
+                "engine.round", "round", self._obs_tid, t_round,
+                self._clock() - t_round, args={"round": self.rounds},
+            )
 
     def _kill_decode_round(self) -> None:
         """The `kill_mid_decode` fault: this round's decode dispatch died
@@ -1027,9 +1064,10 @@ class ServeEngine:
                     # len(prompt) - 1 cap guarantees the final prompt token
                     # is always re-prefilled, so first-token logits come
                     # from a live chunk (never from a skipped one).
-                    mr = self.prefix_cache.match(
-                        req.prompt, max_tokens=len(req.prompt) - 1
-                    )
+                    with self._trace.span("trie.match", "prefix", self._obs_tid):
+                        mr = self.prefix_cache.match(
+                            req.prompt, max_tokens=len(req.prompt) - 1
+                        )
                     if mr.pages:
                         slot.pages = list(mr.pages)
                         slot.n_shared = len(mr.pages)
@@ -1043,6 +1081,10 @@ class ServeEngine:
                         self.cow_pages += 1
                 self.slots[i] = slot
                 self._admitted += 1
+                self._trace.instant(
+                    "admitted", "lifecycle", self._obs_tid,
+                    args={"uid": req.uid, "slot": i},
+                )
 
     def _ensure_pages(self, slot: _Slot, upto_tokens: int) -> bool:
         """Grow slot's page list to cover positions [0, upto_tokens);
@@ -1117,6 +1159,9 @@ class ServeEngine:
         self._release_slot(victim)
         self.slots[i] = None
         self.preemptions += 1
+        self._trace.instant(
+            "preempt", "lifecycle", self._obs_tid, args={"uid": req.uid}
+        )
 
     def _release_slot(self, slot: _Slot) -> None:
         """The ONE funnel a departing slot's pages go through (finish,
@@ -1129,12 +1174,13 @@ class ServeEngine:
         if self.prefix_cache is None:
             self.allocator.free(slot.pages)
             return
-        committed = np.concatenate(
-            [slot.request.prompt, np.asarray(slot.generated, np.int32)]
-        )[: slot.length]
-        self.allocator.free(
-            self.prefix_cache.release(committed, slot.pages, slot.n_shared)
-        )
+        with self._trace.span("trie.release", "prefix", self._obs_tid):
+            committed = np.concatenate(
+                [slot.request.prompt, np.asarray(slot.generated, np.int32)]
+            )[: slot.length]
+            self.allocator.free(
+                self.prefix_cache.release(committed, slot.pages, slot.n_shared)
+            )
 
     def _page_table(self, n_pages: tp.Optional[int] = None) -> np.ndarray:
         table = np.zeros((self.max_slots, n_pages or self.max_pages_per_slot), np.int32)
@@ -1201,33 +1247,38 @@ class ServeEngine:
         chunk_j = jnp.asarray(chunk)
         start_j = jnp.asarray(slot.prompt_pos, jnp.int32)
         n_valid_j = jnp.asarray(n_valid, jnp.int32)
-        logits, self.cache = _serve_prefill_chunk(
-            self.config,
-            self.params,
-            chunk_j,
-            start_j,
-            n_valid_j,
-            self.cache,
-            row,
-            self.mesh,
-        )
-        if self.draft_params is not None and not self.draft_shares_cache:
-            # A separate draft model's pool must hold the same positions as
-            # the target's — the spec round's draft steps attend through the
-            # shared page table under the same per-slot lengths. Draft
-            # prefill logits are discarded (the pending token is sampled
-            # from the TARGET). A prefix self-draft skips this: the target
-            # prefill above already filled its layers of the shared pool.
-            _, self.draft_cache = _serve_prefill_chunk(
-                self.draft_config,
-                self.draft_params,
+        # Span covers host assembly + async ENQUEUE only — prefill logits
+        # are not forced here (mid-prompt chunks never sync; the final
+        # chunk's force happens in the first-token block below).
+        with self._trace.span("prefill.chunk", "prefill", self._obs_tid):
+            logits, self.cache = _serve_prefill_chunk(
+                self.config,
+                self.params,
                 chunk_j,
                 start_j,
                 n_valid_j,
-                self.draft_cache,
+                self.cache,
                 row,
                 self.mesh,
             )
+            if self.draft_params is not None and not self.draft_shares_cache:
+                # A separate draft model's pool must hold the same positions
+                # as the target's — the spec round's draft steps attend
+                # through the shared page table under the same per-slot
+                # lengths. Draft prefill logits are discarded (the pending
+                # token is sampled from the TARGET). A prefix self-draft
+                # skips this: the target prefill above already filled its
+                # layers of the shared pool.
+                _, self.draft_cache = _serve_prefill_chunk(
+                    self.draft_config,
+                    self.draft_params,
+                    chunk_j,
+                    start_j,
+                    n_valid_j,
+                    self.draft_cache,
+                    row,
+                    self.mesh,
+                )
         slot.prompt_pos += n_valid
         slot.length = slot.prompt_pos
         self.prefilled_tokens += n_valid
@@ -1243,20 +1294,25 @@ class ServeEngine:
             # Prompt complete: sample the first generated token from the
             # last valid prompt position's logits (host-side; greedy argmax
             # matches engine.generate's sample_logits(temperature=0) exactly).
-            last = np.asarray(logits)[0, n_valid - 1]
-            if self.temperature == 0.0:
-                tok = int(np.argmax(last.astype(np.float32)))
-            else:
-                self._key, k = jax.random.split(self._key)
-                tok = int(
-                    sample_logits(
-                        jnp.asarray(last)[None],
-                        k,
-                        self.temperature,
-                        self.top_k,
-                        self.top_p,
-                    )[0]
-                )
+            # The np.asarray is the force/sync — the span holds the device
+            # wait for the final prefill chunk plus the host sample.
+            with self._trace.span(
+                "prefill.first_token", "prefill", self._obs_tid
+            ):
+                last = np.asarray(logits)[0, n_valid - 1]
+                if self.temperature == 0.0:
+                    tok = int(np.argmax(last.astype(np.float32)))
+                else:
+                    self._key, k = jax.random.split(self._key)
+                    tok = int(
+                        sample_logits(
+                            jnp.asarray(last)[None],
+                            k,
+                            self.temperature,
+                            self.top_k,
+                            self.top_p,
+                        )[0]
+                    )
             self._append_token(slot_i, slot, tok, self._clock())
 
     def _decode_round(self) -> None:
@@ -1294,6 +1350,12 @@ class ServeEngine:
         if not active_idx:
             return
 
+        # Round decomposition (obs/__init__.py docstring): t0 -> t1 is host
+        # assembly + jit ENQUEUE, t1 -> t_done is device compute + tunnel
+        # round-trip (the np.asarray force is the only sync that works
+        # through the tunnel — CLAUDE.md), t_done -> t_post is token commit.
+        obs = self.obs
+        t0 = 0.0 if obs is None else self._clock()
         token = np.zeros((self.max_slots,), np.int32)
         lengths = np.zeros((self.max_slots,), np.int32)
         active = np.zeros((self.max_slots,), bool)
@@ -1325,6 +1387,7 @@ class ServeEngine:
             self.mesh,
             self._split_bucket(round_span),
         )
+        t1 = 0.0 if obs is None else self._clock()
         toks = np.asarray(toks)  # (n, B) — forces the dispatch
         t_done = self._clock()
         for i in active_idx:
@@ -1335,6 +1398,10 @@ class ServeEngine:
                 slot.length += 1
                 if self._append_token(i, slot, int(toks[j, i]), t_done):
                     break  # finished (max_new or EOS); rest of chunk discarded
+        if obs is not None:
+            obs.record_round(
+                "decode", self._obs_tid, t0, t1, t_done, self._clock()
+            )
 
     def _spec_round(self) -> None:
         """One speculative round: k draft proposals per active slot (one
@@ -1379,6 +1446,11 @@ class ServeEngine:
         if not active_idx:
             return
 
+        # Same four-boundary decomposition as _decode_round; t1 is taken
+        # after the VERIFY call returns (both programs enqueued by then),
+        # with draft/verify enqueue sub-spans recorded off the same reads.
+        obs = self.obs
+        t0 = 0.0 if obs is None else self._clock()
         token = np.zeros((self.max_slots,), np.int32)
         lengths = np.zeros((self.max_slots,), np.int32)
         active = np.zeros((self.max_slots,), bool)
@@ -1424,6 +1496,7 @@ class ServeEngine:
             self.mesh,
             split_k,
         )
+        t_draft = 0.0 if obs is None else self._clock()
         if shared:
             self.cache = draft_cache_out
         else:
@@ -1446,6 +1519,7 @@ class ServeEngine:
             self.mesh,
             split_k,
         )
+        t1 = 0.0 if obs is None else self._clock()
         n_accept = np.asarray(n_accept)
         out = np.asarray(out)  # forces both dispatches
         t_done = self._clock()
@@ -1490,6 +1564,17 @@ class ServeEngine:
                 tail = slot.pages[keep:]
                 del slot.pages[keep:]
                 self.allocator.free(tail)
+        if obs is not None:
+            obs.record_round(
+                "spec", self._obs_tid, t0, t1, t_done, self._clock()
+            )
+            self._trace.complete(
+                "spec.draft_enqueue", "spec", self._obs_tid, t0, t_draft - t0
+            )
+            self._trace.complete(
+                "spec.verify_enqueue", "spec", self._obs_tid, t_draft,
+                t1 - t_draft,
+            )
 
     def spec_stats(self) -> tp.Dict[str, float]:
         """Aggregate speculative counters since construction: acceptance
@@ -1536,6 +1621,10 @@ class ServeEngine:
         the streaming hook — the ONE funnel every path to `finished` goes
         through, so the async server never misses an ending."""
         self.finished[fr.uid] = fr
+        self._trace.instant(
+            "finish", "lifecycle", self._obs_tid,
+            args={"uid": fr.uid, "status": fr.status},
+        )
         if self.on_finish is not None:
             self.on_finish(fr)
 

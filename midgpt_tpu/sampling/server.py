@@ -57,6 +57,8 @@ import collections
 import dataclasses
 import typing as tp
 
+from midgpt_tpu.obs import DISABLED_SNAPSHOT
+from midgpt_tpu.obs.trace import NULL_TRACER
 from midgpt_tpu.robustness import faults, preempt
 from midgpt_tpu.robustness.backoff import backoff_delays
 from midgpt_tpu.sampling.serve import (
@@ -85,6 +87,7 @@ class _Stream:
     buffered: int = 0
     stalled: bool = False
     finished: tp.Optional[FinishedRequest] = None
+    first_token_seen: bool = False  # TTFT instant fired (obs lifecycle)
 
 
 class AsyncServeServer:
@@ -116,6 +119,13 @@ class AsyncServeServer:
         self.honor_preempt_flag = honor_preempt_flag
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
+        # Request-lifecycle tracing rides the ENGINE's observability (the
+        # server claims on_token/on_finish exclusively — obs must not —
+        # so the lifecycle events are emitted from these hook bodies).
+        # NULL_TRACER when the engine runs obs-off: every site is free.
+        self._trace = (
+            engine.obs.tracer if engine.obs is not None else NULL_TRACER
+        )
         self._streams: tp.Dict[int, _Stream] = {}
         # Commands are (fn, future-or-None); appended from the event loop
         # (submit/cancel) or the driver's worker thread (slow-client sheds
@@ -152,6 +162,7 @@ class AsyncServeServer:
                     # robustness/preempt.py): stop admission, finish
                     # in-flight work, exit — the serving twin of the train
                     # loop's emergency-save-and-exit.
+                    self._trace.instant("drain.sigterm", "lifecycle", "server")
                     self._draining = True
                 self._apply_commands()
                 if not self.engine.idle:
@@ -227,6 +238,17 @@ class AsyncServeServer:
                 prompt, max_new_tokens, eos_id=eos_id, ttl_s=ttl_s
             )
             self._streams[uid] = _Stream(queue=asyncio.Queue())
+            # Async span: one Perfetto track per request from accepted
+            # submit to terminal status (_on_finish closes it). Shed
+            # attempts never reach here — the engine emits their instant.
+            self._trace.async_begin(
+                "request", str(uid), "lifecycle", "server",
+                args={
+                    "uid": uid,
+                    "prompt_len": len(prompt),
+                    "max_new_tokens": max_new_tokens,
+                },
+            )
             return uid
 
         delays = backoff_delays(self.submit_retries, self.retry_backoff_s)
@@ -284,6 +306,11 @@ class AsyncServeServer:
             "free_pages": eng.allocator.free_count,
             "prefix": eng.prefix_stats(),
             "mesh": eng.mesh_shape(),
+            # same unified schema as ServeEngine.stats()["obs"]
+            # (docs/OBSERVABILITY.md): round decomposition + metrics
+            "obs": (
+                DISABLED_SNAPSHOT if eng.obs is None else eng.obs.snapshot()
+            ),
         }
 
     async def drain(self) -> None:
@@ -309,6 +336,11 @@ class AsyncServeServer:
         # socket, and the bound below sheds it.
         if faults.should_fire("slow_client", step=uid):
             st.stalled = True
+        if not st.first_token_seen:
+            st.first_token_seen = True
+            self._trace.instant(
+                "first_token", "lifecycle", "server", args={"uid": uid}
+            )
         st.buffered += 1
         if not st.stalled:
             self._loop.call_soon_threadsafe(st.queue.put_nowait, tok)
@@ -316,6 +348,9 @@ class AsyncServeServer:
             # Bounded-buffer shed: the client is not draining; cancel at
             # the next round boundary instead of holding pool pages behind
             # a dead consumer.
+            self._trace.instant(
+                "slow_client_shed", "lifecycle", "server", args={"uid": uid}
+            )
             self._cmds.append(
                 (lambda: self.engine.cancel(uid, status="slow_client"), None)
             )
@@ -325,4 +360,8 @@ class AsyncServeServer:
         if st is None:
             return
         st.finished = fr
+        self._trace.async_end(
+            "request", str(fr.uid), "lifecycle", "server",
+            args={"status": fr.status},
+        )
         self._loop.call_soon_threadsafe(st.queue.put_nowait, _END)

@@ -48,6 +48,7 @@ import typing as tp
 import jax
 import orbax.checkpoint as ocp
 
+from midgpt_tpu.obs import flight_recorder
 from midgpt_tpu.robustness import faults
 from midgpt_tpu.robustness.backoff import retry_with_backoff
 from midgpt_tpu.robustness.errors import (
@@ -270,12 +271,18 @@ class CheckpointManager:
         try:
             # Shared retry discipline (robustness/backoff.py) — the same
             # schedule the serving front door applies to BackpressureError.
-            queued = retry_with_backoff(
-                _queue_write,
-                retries=self.write_retries,
-                base_s=self.retry_backoff_sec,
-                retry_on=(OSError,),  # includes IOError; TensorStore failures
-            )
+            # The span holds only the SYNCHRONOUS queue (+ retries); the
+            # TensorStore write itself is async and lands under the
+            # ckpt.finalize span at the next barrier.
+            with flight_recorder().tracer.span(
+                "ckpt.save_queue", "ckpt", "train"
+            ):
+                queued = retry_with_backoff(
+                    _queue_write,
+                    retries=self.write_retries,
+                    base_s=self.retry_backoff_sec,
+                    retry_on=(OSError,),  # includes IOError; TensorStore failures
+                )
         except OSError as e:
             raise CheckpointWriteError(
                 f"checkpoint save at step {step} under {self._dir} failed "
@@ -316,28 +323,36 @@ class CheckpointManager:
         step, self._pending = self._pending, None
         if step is None:
             return
-        self._mngr.wait_until_finished()
-        self._mngr.check_for_errors()
-        if not self._local:
-            return
-        d = self._step_dir(step)
-        if d is None:
-            return
-        write_manifest(d, step)
-        if faults.should_fire("truncate_ckpt_item", step=step):
-            # Corruption AFTER the manifest committed (bit rot / bad copy):
-            # the recorded hashes no longer match the bytes.
-            self._corrupt_one_item(step)
-        problems = self.verify(step)
-        if problems:
-            if jax.process_index() == 0:
-                print(
-                    f"WARNING: checkpoint step {step} failed post-save "
-                    "verification and will not be resumed from:\n  "
-                    + "\n  ".join(problems)
+        tr = flight_recorder().tracer
+        with tr.span("ckpt.finalize", "ckpt", "train"):
+            self._mngr.wait_until_finished()
+            self._mngr.check_for_errors()
+            if not self._local:
+                return
+            d = self._step_dir(step)
+            if d is None:
+                return
+            write_manifest(d, step)
+            if faults.should_fire("truncate_ckpt_item", step=step):
+                # Corruption AFTER the manifest committed (bit rot / bad
+                # copy): the recorded hashes no longer match the bytes.
+                self._corrupt_one_item(step)
+            with tr.span("ckpt.verify", "ckpt", "train"):
+                problems = self.verify(step)
+            if problems:
+                tr.instant(
+                    "ckpt.verify_failed", "ckpt", "train",
+                    args={"step": step, "n_problems": len(problems)},
                 )
-            return  # keep older verified steps; no GC off an unverified save
-        self._gc()
+                if jax.process_index() == 0:
+                    print(
+                        f"WARNING: checkpoint step {step} failed post-save "
+                        "verification and will not be resumed from:\n  "
+                        + "\n  ".join(problems)
+                    )
+                return  # keep older verified steps; no GC off an unverified save
+            tr.instant("ckpt.verified", "ckpt", "train", args={"step": step})
+            self._gc()
 
     def _gc(self) -> None:
         """Delete steps older than the `max_to_keep`-newest verified steps.

@@ -31,6 +31,7 @@ import optax
 from midgpt_tpu.config import ExperimentConfig
 from midgpt_tpu.data.dataset import TokenDataset
 from midgpt_tpu.models.gpt import GPT, GPTParams
+from midgpt_tpu.obs import dump_flight_recorder, flight_recorder
 from midgpt_tpu.ops.loss import fused_linear_cross_entropy
 from midgpt_tpu.parallel.data import make_global_batch
 from midgpt_tpu.parallel.fsdp import constrain, named_shardings
@@ -540,15 +541,22 @@ def train(
     step_cache_size = functools.partial(jit_cache_size, step)
     warned_recompile = False
     preempted = False
+    # Training-side flight recorder (midgpt_tpu/obs/): per-step spans and
+    # lifecycle instants land in the process-global ring; crash paths
+    # (DivergenceError in the supervisor, the preempt branch below) dump it
+    # to the rundir as a Chrome trace for postmortems. Host-side only —
+    # spans never cross the jit boundary, so the step program is untouched.
+    _tr = flight_recorder().tracer
     try:
         for itr in range(first_step, config.max_steps):
             if itr % config.eval_interval == 0:
-                metrics["loss/train"] = evaluate(
-                    config, eval_loss_many, params, dataset, "train", mesh, itr
-                )
-                metrics["loss/val"] = evaluate(
-                    config, eval_loss_many, params, dataset, "val", mesh, itr
-                )
+                with _tr.span("train.eval", "train", "train"):
+                    metrics["loss/train"] = evaluate(
+                        config, eval_loss_many, params, dataset, "train", mesh, itr
+                    )
+                    metrics["loss/val"] = evaluate(
+                        config, eval_loss_many, params, dataset, "val", mesh, itr
+                    )
                 logger.log(itr, {k: metrics[k] for k in ("loss/train", "loss/val")})
                 t_last, tokens_since = _time.time(), 0  # eval pauses don't count
 
@@ -558,7 +566,13 @@ def train(
             yg = make_global_batch(y, mesh, data_sp)
             step_key = jax.random.fold_in(base_key, data_itr)
             profiler.maybe_start(itr, at_step=first_step + 1)
-            params, opt_state, loss = step(params, opt_state, xg, yg, step_key, loss)
+            # Span covers host-side batch feed + async ENQUEUE of the one
+            # step program — device time shows up at the log-interval float
+            # sync, not here (the tunnel-safe measurement discipline;
+            # tools/profile_summary.py --correlate lines host spans up
+            # against xplane device time).
+            with _tr.span("train.step", "train", "train"):
+                params, opt_state, loss = step(params, opt_state, xg, yg, step_key, loss)
             profiler.maybe_stop(wait_for=loss)
 
             if faults.should_fire("nan_grad", step=data_itr):
@@ -583,6 +597,10 @@ def train(
                     # window (robustness/supervisor.py).
                     last_good = (
                         mngr.latest_verified_step() if mngr is not None else None
+                    )
+                    _tr.instant(
+                        "train.divergence", "train", "train",
+                        args={"step": itr, "last_good": last_good},
                     )
                     raise DivergenceError(
                         f"non-finite loss ({loss_f}) at step {itr} — training "
@@ -642,6 +660,10 @@ def train(
                 # a poisoned state overwrite the rolling checkpoints.
                 if not np.isfinite(float(loss)):
                     last_good = mngr.latest_verified_step()
+                    _tr.instant(
+                        "train.divergence", "train", "train",
+                        args={"step": itr, "last_good": last_good},
+                    )
                     raise DivergenceError(
                         f"non-finite training state at step {itr} — refusing "
                         "to overwrite the rolling checkpoint. Last good "
@@ -669,6 +691,14 @@ def train(
                     mngr.wait()  # barrier + manifest: verified before we exit
                 metrics["preempted"] = True
                 preempted = True
+                _tr.instant(
+                    "train.preempt", "train", "train", args={"step": itr}
+                )
+                if config.rundir and jax.process_index() == 0:
+                    # SIGTERM postmortem artifact: the flight recorder's
+                    # crash-adjacent tail as a loadable Chrome trace
+                    # (docs/OBSERVABILITY.md "Crash dumps").
+                    dump_flight_recorder(config.rundir)
                 if jax.process_index() == 0:
                     print(
                         f"preemption: emergency checkpoint at step {itr} in "

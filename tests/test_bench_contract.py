@@ -390,11 +390,13 @@ def test_checker_catches_field_drift():
 
 
 def test_serve_slo_checker_catches_drift():
+    decomp = {"p50": 1.0, "p95": 2.0}
     point = {
         "offered_rps": 30.0, "n_offered": 4, "completed": 4, "shed": 0,
         "timeouts": 0, "shed_frac": 0.0, "timeout_frac": 0.0,
         "ttft_p50_ms": 5.0, "ttft_p95_ms": 9.0, "tpot_p50_ms": 1.0,
-        "tpot_p95_ms": 2.0,
+        "tpot_p95_ms": 2.0, "rounds": 8,
+        "round_host_ms": dict(decomp), "round_device_ms": dict(decomp),
     }
     good = {
         "bench": "serve_slo", "backend": "cpu", "process": "poisson",
@@ -403,8 +405,15 @@ def test_serve_slo_checker_catches_drift():
         "points": [point, dict(point, offered_rps=90.0)],
         "ttft_p50_ms": 5.0, "ttft_p95_ms": 9.0, "tpot_p50_ms": 1.0,
         "tpot_p95_ms": 2.0, "shed_frac": 0.0, "timeout_frac": 0.0,
+        "round_host_ms": dict(decomp), "round_device_ms": dict(decomp),
     }
     assert check_serve_slo_bench(good) == []
+    # round-decomposition drift (docs/OBSERVABILITY.md): a missing or
+    # malformed host/device object fails, as does a negative quantile
+    no_decomp = dict(good, round_host_ms=None)
+    assert any("round_host_ms" in p for p in check_serve_slo_bench(no_decomp))
+    neg = dict(good, round_device_ms={"p50": -1.0, "p95": 2.0})
+    assert any("round_device_ms.p50" in p for p in check_serve_slo_bench(neg))
     # one load point is a measurement, not the SLO curve the profile wants
     one_point = dict(good, points=[point])
     assert any(">= 2" in p for p in check_serve_slo_bench(one_point))

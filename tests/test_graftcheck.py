@@ -1,5 +1,7 @@
 """graftcheck pass-1 lint + pass-3 lifecycle: one deliberate-violation
-fixture per rule (GC001-GC011), suppression semantics, and the CLI
+fixture per rule (GC001-GC011; path-scoped GC012 gets dedicated tests
+below — it cannot live in FIXTURES because it only fires under
+`sampling/` / `robustness/` paths), suppression semantics, and the CLI
 contract (nonzero exit with rule ID + file:line on violations; --json is
 one schema-conformant line; --fail-on-new gates on the committed
 baseline). The repo-wide "tree is clean" gate lives in
@@ -257,6 +259,62 @@ def test_gc006_accepts_reference_or_test_citation():
         src = f'def f(q):\n    """Parity pinned ({cite})."""\n    return q\n'
         active, _ = lint_source(src, "cited.py")
         assert active == [], cite
+
+
+def test_gc012_bare_clock_call_fires_only_in_scope():
+    """Path-scoped: a bare clock CALL flags under sampling/ and
+    robustness/ components, and nowhere else."""
+    src = """\
+import time
+
+class Engine:
+    def step(self):
+        t0 = time.perf_counter()
+        return t0
+"""
+    active, _ = check_source(src, "midgpt_tpu/sampling/serve.py")
+    assert [(f.rule, f.line) for f in active] == [("GC012", 5)]
+    active, _ = check_source(
+        src.replace("perf_counter", "time"),
+        "midgpt_tpu/robustness/supervisor.py",
+    )
+    assert [(f.rule, f.line) for f in active] == [("GC012", 5)]
+    # the SAME source outside injectable-clock territory never flags
+    for path in ("midgpt_tpu/training/train.py", "tools/loadgen.py"):
+        active, _ = check_source(src, path)
+        assert active == [], path
+
+
+def test_gc012_plumbing_and_sleep_are_exempt():
+    """`clock=time.perf_counter` is a reference (the plumbing itself, not
+    a read) and `time.sleep` is a delay, not a measurement — the exact
+    shapes sampling/serve.py and robustness/supervisor.py use."""
+    src = """\
+import time
+
+class Engine:
+    def __init__(self, clock=time.perf_counter, sleep_fn=time.sleep):
+        self._clock = clock
+        self._sleep = sleep_fn
+
+    def step(self):
+        time.sleep(0.01)
+        return self._clock()
+"""
+    active, _ = check_source(src, "midgpt_tpu/sampling/serve.py")
+    assert active == []
+
+
+def test_gc012_suppressible_inline():
+    src = """\
+import time
+
+def arrival_stamp():
+    return time.time()  # graftcheck: disable=GC012 — wall-anchored arrival timestamp for logs
+"""
+    active, suppressed = check_source(src, "midgpt_tpu/sampling/server.py")
+    assert active == []
+    assert [(f.rule, f.line) for f in suppressed] == [("GC012", 4)]
 
 
 # ----------------------------------------------------------------------

@@ -240,6 +240,37 @@ def test_prefix_cache_compiles_zero_new_programs(params):
     assert cc.count == 0, f"prefix cache compiled {cc.count} new program(s)"
 
 
+def test_obs_toggle_compiles_zero_new_programs(params):
+    """Tentpole pin (observability PR): the flight recorder is host-side
+    only — clock reads and ring appends around the jit calls, never
+    through them — so an obs-ON engine compiles NOTHING an obs-off engine
+    at the same geometry didn't already compile, and no span/metric state
+    ever becomes a jit static. Warm-then-count on the 31-page pool so this
+    pin composes with the pristine-baseline pins above."""
+    from midgpt_tpu.obs import Observability
+
+    def mix(obs, seed):
+        eng = ServeEngine(
+            CFG, params, max_slots=3, page_size=8, num_pages=31,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32, obs=obs,
+        )
+        rng = np.random.default_rng(seed)
+        uids = [
+            eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+            for n, m in zip((25, 34, 47), (9, 17, 17))
+        ]
+        assert set(eng.run()) == set(uids)
+        return eng
+
+    mix(None, seed=0)  # warm every program this geometry/mix reaches
+    with CompileCounter() as cc:
+        eng = mix(Observability(), seed=0)  # same mix, recorder on
+        mix(Observability(), seed=1)  # fresh content, same buckets
+    assert cc.count == 0, f"obs toggle compiled {cc.count} new program(s)"
+    assert eng.stats()["obs"]["round_decomp"]["rounds"] > 0
+
+
 def test_train_step_compiles_exactly_once():
     cfg = ExperimentConfig(
         rundir="",

@@ -17,7 +17,10 @@ gap/n), and the HBM high-water of each mode's cache (analytic bytes — the
 paged pool vs the per-request contiguous cache — plus the device allocator
 peak when the backend exposes one; per CLAUDE.md, wall-clock through the
 TPU tunnel is untrustworthy below many iterations, so treat the CPU-mesh
-numbers as scheduling-structure signal, not kernel-speed signal).
+numbers as scheduling-structure signal, not kernel-speed signal). The
+timed continuous run carries a flight recorder (midgpt_tpu/obs/): the
+line reports `round_host_ms`/`round_device_ms` p50/p95 — the decode-round
+host-vs-device split — and `--trace-out DIR` dumps the Chrome trace.
 
     python tools/bench_serve.py [--n-requests 12] [--max-slots 4] ...
 """
@@ -716,6 +719,11 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=6,
                     help="--long-ctx: timed decode rounds per variant "
                     "(median reported; one extra warm round rides first)")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="plain serve profile: directory to dump the timed "
+                    "continuous run's flight recorder as a Chrome-trace "
+                    "JSON (+ .prom metrics) — open in Perfetto or roll up "
+                    "with tools/trace_view.py (docs/OBSERVABILITY.md)")
     args = ap.parse_args()
     if args.n_layer is None:
         args.n_layer = 6 if args.spec else 4
@@ -790,7 +798,7 @@ def main() -> int:
         {"pool_hbm_bytes": args.pool_hbm_bytes} if args.pool_hbm_bytes else {}
     )
 
-    def run_continuous(dtype):
+    def run_continuous(dtype, obs=None):
         eng = ServeEngine(
             cfg,
             params,
@@ -800,6 +808,7 @@ def main() -> int:
             decode_chunk=args.decode_chunk,
             temperature=0.0,
             cache_dtype=dtype,
+            obs=obs,
             **pool_kw,
         )
         uids = [(eng.submit(p, m), len(p)) for p, m in trace]
@@ -818,8 +827,14 @@ def main() -> int:
         outs = [np.asarray(o) for o in outs]  # force
         return time.perf_counter() - t0
 
+    from midgpt_tpu.obs import Observability
+
     run_continuous(cache_dtype)  # warm every prefill/decode-chunk shape
-    eng, done, dt_cont, t_start, uids = run_continuous(cache_dtype)
+    # Flight recorder on the TIMED run only: the serve profile reports the
+    # decode-round host/device decomposition (docs/OBSERVABILITY.md) next
+    # to its throughput, from the same pass.
+    obs = Observability()
+    eng, done, dt_cont, t_start, uids = run_continuous(cache_dtype, obs=obs)
     run_sequential()  # warm per-prompt-length prefills + decode chunks
     dt_seq = run_sequential()
 
@@ -845,6 +860,25 @@ def main() -> int:
         }
 
     lat, ttft, req_rate = _latency_stats(done, t_start)
+
+    # Round split: host = dispatch (assembly + jit enqueue) + host_post
+    # (token commit); device = device_wait (enqueue -> array landed).
+    # Percentile sums are a summary convenience, not a joint distribution.
+    decomp = obs.round_decomp()
+    round_host_ms = {
+        "p50": round(
+            decomp["dispatch"]["p50_ms"] + decomp["host_post"]["p50_ms"], 3
+        ),
+        "p95": round(
+            decomp["dispatch"]["p95_ms"] + decomp["host_post"]["p95_ms"], 3
+        ),
+    }
+    round_device_ms = {
+        "p50": decomp["device_wait"]["p50_ms"],
+        "p95": decomp["device_wait"]["p95_ms"],
+    }
+    if args.trace_out:
+        obs.dump(args.trace_out, filename="bench_serve.json")
 
     # HBM high-water of the caches (analytic; allocator peak if exposed).
     paged_bytes = eng.cache_hbm_bytes()
@@ -888,6 +922,9 @@ def main() -> int:
                 "ttft_ms_p95": round(float(np.percentile(ttft, 95)) * 1e3, 3),
                 "req_tok_s_p50": round(float(np.percentile(req_rate, 50)), 2),
                 "req_tok_s_p95": round(float(np.percentile(req_rate, 95)), 2),
+                "decode_rounds": decomp["rounds"],
+                "round_host_ms": round_host_ms,
+                "round_device_ms": round_device_ms,
                 # pools + (int8) scale side buffers — the true cache spend
                 "cache_hbm_bytes": int(paged_bytes),
                 "hbm_paged_cache_bytes": int(paged_bytes),

@@ -17,6 +17,11 @@ run — robustness/chaos_serve.py) and reports shed/timeout counts:
     python tools/chaos_run.py --serve --fault kill_mid_decode@6
     python tools/chaos_run.py --serve --fault poisoned_page@8 --fault slow_client@1
 
+With `--rundir`, serving mode records the fault pass under a flight
+recorder and leaves `flight_recorder.json` (Chrome trace — open in
+Perfetto or summarize with tools/trace_view.py) plus `.prom` metrics
+there, even when a degradation invariant fails (docs/OBSERVABILITY.md).
+
 Fault spec grammar: `kind[@step][*times]` (robustness/faults.py;
 MIDGPT_FAULTS env works too). Serving step keys: engine round for
 kill_mid_decode/poisoned_page, victim uid for slow_client, arrival index
@@ -61,7 +66,8 @@ def _serve_main(args) -> int:
     result: dict = {}
     try:
         result = run_serving_chaos(
-            ",".join(args.fault), seed=args.seed, n_requests=args.n_requests
+            ",".join(args.fault), seed=args.seed, n_requests=args.n_requests,
+            trace_dir=args.rundir,
         )
     except AssertionError as e:
         status = "failed"

@@ -16,7 +16,15 @@ the cross-request prefix cache on and per-point/headline
 
     python tools/loadgen.py --process poisson --rates 20,60 \
         [--scheduler slo] [--ttl-s 2.0] [--slo-ttft-ms 500 --slo-tpot-ms 50] \
-        [--error-budget 0.2] [--cpu-devices 8]
+        [--error-budget 0.2] [--cpu-devices 8] [--trace-out /tmp/traces]
+
+Every engine runs under a per-point flight recorder (midgpt_tpu/obs/):
+each point (and the headline, from the hottest point) carries
+`round_host_ms`/`round_device_ms` p50/p95 — the decode-round split into
+host work (batch assembly + jit enqueue + token commit) vs device wait
+(docs/OBSERVABILITY.md). `--trace-out DIR` additionally dumps one
+Chrome-trace JSON (+ .prom metrics) per point for Perfetto /
+tools/trace_view.py.
 
 Client-perceived metrics: TTFT is measured from the client's submit
 attempt (admission retries and queueing included — that is what a user
@@ -261,6 +269,10 @@ def main() -> int:
                     help="p95 TPOT target (0 = unset)")
     ap.add_argument("--error-budget", type=float, default=0.2,
                     help="max shed+timeout fraction for a point to be slo_ok")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="directory to dump one Chrome-trace flight "
+                    "recorder (+ .prom metrics) per offered-load point — "
+                    "open in Perfetto or roll up with tools/trace_view.py")
     # engine/model shape (tiny defaults: the CPU-mesh scheduling testbed)
     ap.add_argument("--max-slots", type=int, default=3)
     ap.add_argument("--page-size", type=int, default=8)
@@ -310,6 +322,7 @@ def main() -> int:
     import jax.numpy as jnp
 
     from midgpt_tpu.models.gpt import GPT, GPTConfig
+    from midgpt_tpu.obs import Observability
     from midgpt_tpu.sampling.scheduler import FCFSScheduler, SLOScheduler
     from midgpt_tpu.sampling.serve import ServeEngine
     from midgpt_tpu.sampling.server import AsyncServeServer
@@ -337,7 +350,7 @@ def main() -> int:
             raise SystemExit(f"--tp {args.tp} must divide n_head {cfg.n_head}")
         mesh = make_serve_mesh(tp_size=args.tp)
 
-    def make_engine():
+    def make_engine(obs=None):
         sched = (
             SLOScheduler(min_headroom_s=args.min_headroom_s)
             if args.scheduler == "slo"
@@ -357,6 +370,7 @@ def main() -> int:
             scheduler=sched,
             prefix_cache=bool(args.prefix_cache),
             mesh=mesh,
+            obs=obs,
         )
 
     # Warm EVERY (decode-chunk tail x page bucket) program the workload
@@ -392,7 +406,12 @@ def main() -> int:
         arrivals = _arrivals(
             args.process, rate, args.n_requests, point_rng, args.burst_size
         )
-        engine = make_engine()
+        # One flight recorder per point: round decomposition percentiles
+        # (dispatch / device_wait / host_post — docs/OBSERVABILITY.md) are
+        # per-offered-load numbers, and a dumped trace must cover exactly
+        # one point to be readable.
+        obs = Observability()
+        engine = make_engine(obs)
         server = AsyncServeServer(engine, idle_poll_s=0.001)
 
         async def run_point():
@@ -415,6 +434,28 @@ def main() -> int:
             stats["prefix_hit_rate"] = round(
                 server.stats()["prefix"]["hit_rate"], 4
             )
+        # Round timing decomposition, read the same way a deployment
+        # would: through the stats() obs payload. host = dispatch (batch
+        # assembly + jit enqueue) + host_post (token commit); device =
+        # device_wait (enqueue -> array landed, the only tunnel-safe sync
+        # point). Percentile sums are a summary convenience, not a joint
+        # distribution claim.
+        decomp = server.stats()["obs"]["round_decomp"]
+        stats["rounds"] = decomp["rounds"]
+        stats["round_host_ms"] = {
+            "p50": round(
+                decomp["dispatch"]["p50_ms"] + decomp["host_post"]["p50_ms"], 3
+            ),
+            "p95": round(
+                decomp["dispatch"]["p95_ms"] + decomp["host_post"]["p95_ms"], 3
+            ),
+        }
+        stats["round_device_ms"] = {
+            "p50": decomp["device_wait"]["p50_ms"],
+            "p95": decomp["device_wait"]["p95_ms"],
+        }
+        if args.trace_out:
+            obs.dump(args.trace_out, filename=f"loadgen_point{pi}_r{rate:g}.json")
         points.append(stats)
 
     worst = points[-1]  # rates ascending by convention: report the hottest
@@ -456,6 +497,8 @@ def main() -> int:
                 "tpot_p95_ms": worst["tpot_p95_ms"],
                 "shed_frac": worst["shed_frac"],
                 "timeout_frac": worst["timeout_frac"],
+                "round_host_ms": worst["round_host_ms"],
+                "round_device_ms": worst["round_device_ms"],
                 "prefix_hit_rate": worst.get("prefix_hit_rate"),
                 "slo_ok": bool(all(p["slo_ok"] for p in points)),
             }

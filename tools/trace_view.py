@@ -1,0 +1,114 @@
+"""Load, validate, and summarize a flight-recorder Chrome trace.
+
+The flight recorder (midgpt_tpu/obs/) dumps `{"traceEvents": [...]}` JSON
+that Perfetto (https://ui.perfetto.dev) and chrome://tracing open directly.
+This tool is the headless companion for hosts without a browser: it
+validates the file is a loadable Chrome trace, rolls up span time by name,
+and prints the event tail — the postmortem workflow after a chaos run or
+a crash dump (docs/OBSERVABILITY.md).
+
+Usage:
+    python tools/trace_view.py <flight_recorder.json> [--top K] [--tail N]
+    python tools/trace_view.py <dir>        # finds *flight_recorder*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(
+        glob.glob(os.path.join(path, "**", "*flight_recorder*.json"),
+                  recursive=True)
+        + glob.glob(os.path.join(path, "**", "*trace*.json"), recursive=True)
+    )
+    if not hits:
+        sys.exit(f"no flight-recorder json under {path}")
+    return hits[-1]
+
+
+def load_trace(path: str) -> list:
+    """Parse and structurally validate; returns the traceEvents list.
+    Raises ValueError on anything Perfetto would choke on."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace (no traceEvents)")
+    evs = doc["traceEvents"]
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict) or "ph" not in ev or "name" not in ev:
+            raise ValueError(f"{path}: event {i} missing ph/name")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"{path}: complete event {i} missing dur")
+    return evs
+
+
+def summarize(evs: list) -> dict:
+    """Per-name span rollup + per-phase counts (tests use this too)."""
+    by_name: collections.Counter = collections.Counter()
+    counts: collections.Counter = collections.Counter()
+    phases: collections.Counter = collections.Counter()
+    threads = {}
+    for ev in evs:
+        phases[ev["ph"]] += 1
+        if ev["ph"] == "M" and ev["name"] == "thread_name":
+            threads[ev.get("tid")] = ev.get("args", {}).get("name")
+        if ev["ph"] == "X":
+            by_name[ev["name"]] += ev["dur"]
+            counts[ev["name"]] += 1
+    return {
+        "n_events": len(evs),
+        "phases": dict(phases),
+        "threads": threads,
+        "span_us_by_name": dict(by_name),
+        "span_counts": dict(counts),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="flight_recorder.json or a dir holding one")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--tail", type=int, default=10,
+                    help="print the last N events (the crash-adjacent tail)")
+    args = ap.parse_args()
+
+    path = find_trace(args.trace)
+    evs = load_trace(path)
+    s = summarize(evs)
+    print(f"== {path}: {s['n_events']} events ==")
+    print("phases:", " ".join(f"{k}={v}" for k, v in sorted(s["phases"].items())))
+    if s["threads"]:
+        print("threads:", ", ".join(
+            f"{lane}:{name}" for lane, name in sorted(s["threads"].items())
+        ))
+    rollup = sorted(
+        s["span_us_by_name"].items(), key=lambda kv: -kv[1]
+    )[: args.top]
+    if rollup:
+        print(f"\n-- top {args.top} spans by total time --")
+        for name, us in rollup:
+            n = s["span_counts"][name]
+            print(f"{us/1e3:10.3f} ms x{n:<6} {name}")
+    if args.tail:
+        print(f"\n-- last {args.tail} events --")
+        timed = [e for e in evs if e["ph"] != "M"]
+        for ev in timed[-args.tail:]:
+            dur = f" dur={ev['dur']:.1f}us" if "dur" in ev else ""
+            print(f"  ts={ev.get('ts', 0):12.1f} [{ev['ph']}]{dur} "
+                  f"{ev['name']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
