@@ -417,6 +417,94 @@ def check_serve_longctx_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_ops_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --hot-swap profile: zero-downtime model ops
+    (docs/ROBUSTNESS.md 'Zero-downtime model ops'). A verified-checkpoint
+    blue/green weight swap lands mid-trace, then the pool grows live; the
+    record carries the downtime claim, so its gates are structural:
+
+      * dropped == 0 — zero-downtime means every admitted stream finishes.
+      * swap_recompiles == 0 EXACTLY — a same-shape swap device_puts the
+        candidate onto the live shardings, so the serving jits' caches must
+        not grow at all; any new program means the staged params took a new
+        compile key and the 'live' in 'live swap' is a lie.
+      * parity_old_side + parity_new_side == n_requests, both sides >= 1 —
+        streams served before the flip must be bit-identical to the old
+        weights' reference, streams admitted after to the new weights'; an
+        empty side means the swap landed outside the traffic window and the
+        A/B is vacuous.
+      * pages_migrated >= 1 and pages_conserved — the resize leg actually
+        moved a resident working set and the free+trie+live accounting
+        closed at every boundary."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "model": (dict,),
+            "num_pages": (int,),
+            "kv_dtype": (str,),
+            "checkpoint_step": (int,),
+            "weights_version_before": (str,),
+            "weights_version_after": (str,),
+            "swap_latency_ms": Number,
+            "streams_in_flight_at_flip": (int,),
+            "staged_round": (int,),
+            "flip_round": (int,),
+            "dropped": (int,),
+            "parity_old_side": (int,),
+            "parity_new_side": (int,),
+            "swap_recompiles": (int,),
+            "resize_from_pages": (int,),
+            "resize_to_pages": (int,),
+            "pages_migrated": (int,),
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_ops":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_ops'"
+        )
+    if rec.get("dropped") != 0:
+        problems.append(
+            f"dropped {rec.get('dropped')!r} != 0 — a zero-downtime swap "
+            "must finish every admitted stream"
+        )
+    if rec.get("swap_recompiles") != 0:
+        problems.append(
+            f"swap_recompiles {rec.get('swap_recompiles')!r} != 0 — a "
+            "same-shape hot swap must reuse every compiled program"
+        )
+    po, pn, nr = (rec.get(k) for k in
+                  ("parity_old_side", "parity_new_side", "n_requests"))
+    if isinstance(po, int) and isinstance(pn, int):
+        if po < 1 or pn < 1:
+            problems.append(
+                f"parity sides {po}/{pn} — the flip must land inside the "
+                "traffic window (both sides non-empty)"
+            )
+        if isinstance(nr, int) and po + pn != nr:
+            problems.append(
+                f"parity_old_side {po} + parity_new_side {pn} != "
+                f"n_requests {nr} — some stream matched neither reference"
+            )
+    if rec.get("weights_version_before") == rec.get("weights_version_after"):
+        problems.append("weights_version did not change across the swap")
+    pm = rec.get("pages_migrated")
+    if isinstance(pm, int) and pm < 1:
+        problems.append(f"pages_migrated {pm} < 1 — the resize leg was vacuous")
+    if "pages_conserved" not in rec or rec["pages_conserved"] is not True:
+        problems.append("field 'pages_conserved' must be literal true")
+    sl = rec.get("swap_latency_ms")
+    if isinstance(sl, Number) and sl < 0:
+        problems.append(f"swap_latency_ms {sl} < 0")
+    return problems
+
+
 def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     """tools/loadgen.py profile: TTFT/TPOT percentiles + shed fraction
     under a seeded arrival process, at >= 2 offered-load points (one point
@@ -533,6 +621,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "serve_prefix": check_serve_prefix_bench,
     "serve_tp": check_serve_tp_bench,
     "serve_longctx": check_serve_longctx_bench,
+    "serve_ops": check_serve_ops_bench,
     "serve_slo": check_serve_slo_bench,
     "graftcheck": check_graftcheck,
 }

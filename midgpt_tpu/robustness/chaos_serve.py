@@ -20,16 +20,38 @@ gate (tests/test_chaos_serve.py, `chaos_run.py --serve`) enforces:
      recovery is recompute preemption, which is parity-preserving — so
      there every request must match.
 
-Faults are deterministic for a seeded trace: kill_mid_decode/poisoned_page
-key on the engine's round counter (`kill_mid_decode@7` = round 7),
-slow_client keys on the victim uid, submit_storm keys on the arrival index
-at which the burst lands. This module is import-light glue; the faults it
-arms live in the one registry every chaos path shares.
+Two model-ops scenarios ride the same harness (sampling/ops.py,
+docs/ROBUSTNESS.md "Zero-downtime model ops") with a THREE-sided parity
+check instead of invariant 3's two-sided one:
+
+  * `hot_swap_mid_decode@k` — verified-checkpoint weights (saved and
+    restored through the real training/checkpoint.py manifest path) are
+    staged at round k and flip blue/green: zero streams dropped, streams
+    finished before the flip bit-match a fault-free OLD-weights pass,
+    streams admitted after bit-match a fault-free NEW-weights pass, pool +
+    trie conserved across the flip.
+  * `pool_resize@j,pool_resize@k` — the pool grows then shrinks mid-trace
+    (engine `resize_plan`), on an int8 cache so the scale side buffers
+    must migrate with their pages: conservation holds at every boundary
+    (asserted inside resize_pool AND after the drain) and EVERY stream is
+    bit-identical to a no-resize pass — a resize affects nobody.
+
+Faults are deterministic for a seeded trace: round-keyed kinds fire on the
+engine's round counter (`kill_mid_decode@7` = round 7), slow_client keys on
+the victim uid, submit_storm keys on the arrival index at which the burst
+lands. This module is import-light glue; the faults it arms live in the one
+registry every chaos path shares.
+
+Every scenario runs its fault pass under a flight recorder and leaves a
+postmortem (`flight_recorder.json` + `.prom`): in `trace_dir` when the
+caller gave one, and in a fresh temp dir — path appended to the failing
+AssertionError — when an invariant breaks without one.
 """
 
 from __future__ import annotations
 
 import asyncio
+import tempfile
 import typing as tp
 
 import numpy as np
@@ -44,6 +66,11 @@ STORM_SIZE = 8
 # Backlog budget armed for storm scenarios — small enough that the burst
 # MUST shed, big enough that the base trace admits.
 STORM_BACKLOG_PAGES = 24
+# Grow-then-shrink targets for the pool_resize scenario, applied in plan
+# order from the 29-page base geometry below. 43/37 are fresh geometries:
+# pool size is a program-key dim and the recompile pins count from
+# pristine/warm baselines in the same pytest process (see _engine).
+RESIZE_TARGETS = [43, 37]
 
 
 def _tiny_model(seed: int):
@@ -86,7 +113,8 @@ def _trace(cfg, seed: int, n_requests: int, shared: bool = False):
 
 
 def _engine(
-    cfg, params, *, max_backlog_pages=None, clock=None, prefix=False, obs=None
+    cfg, params, *, max_backlog_pages=None, clock=None, prefix=False,
+    obs=None, cache_dtype=None,
 ):
     import jax.numpy as jnp
 
@@ -110,7 +138,7 @@ def _engine(
         prefill_chunk=16,
         decode_chunk=4,
         temperature=0.0,
-        cache_dtype=jnp.float32,
+        cache_dtype=jnp.float32 if cache_dtype is None else cache_dtype,
         max_backlog_pages=max_backlog_pages,
         prefix_cache=prefix,
         **kw,
@@ -140,6 +168,28 @@ def _run_plain(eng, trace, storm: bool):
             storm_shed += 1
     eng.run()
     return uid_to_idx, storm_shed
+
+
+def _run_trickle(eng, trace, arrival_stride: int = 2):
+    """Drive the engine with STAGGERED arrivals — one submission every
+    `arrival_stride` rounds — instead of _run_plain's upfront burst, so a
+    mid-trace model op deterministically has traffic on BOTH sides of its
+    boundary (the hot-swap gate needs post-flip admissions). Greedy
+    streams are batch-composition-independent, so parity against an
+    upfront-submitted reference pass is still exact — the same property
+    the preemption/disagg parity gates lean on, pinned end to end in
+    tests/test_chaos_serve.py (hot-swap and pool-resize gates)."""
+    uid_to_idx: tp.Dict[int, int] = {}
+    pending = list(enumerate(trace))
+    r = 0
+    while pending or not eng.idle:
+        if pending and r % arrival_stride == 0:
+            idx, (prompt, m) = pending.pop(0)
+            uid_to_idx[eng.submit(prompt, m)] = idx
+        eng.step()
+        r += 1
+        assert r < 10_000, "trickle drive did not converge"
+    return uid_to_idx
 
 
 def _run_server(eng, trace):
@@ -175,6 +225,73 @@ def _run_server(eng, trace):
     return uid_to_idx, delivered
 
 
+# -- shared scenario scaffolding (one builder, one postmortem policy) ------
+
+
+def _reference_pass(cfg, params, trace, *, prefix=False, cache_dtype=None):
+    """Fault-free pass -> {trace index: full reference token array}. Also
+    warms every jit shape, so the fault pass's timings/timeouts cannot
+    hinge on compile stalls. Clears the registry first: a previously armed
+    plan must never leak into a reference."""
+    faults.clear()
+    ref = _engine(cfg, params, prefix=prefix, cache_dtype=cache_dtype)
+    ref_uids, _ = _run_plain(ref, trace, storm=False)
+    return {
+        idx: np.asarray(ref.finished[uid].tokens)
+        for uid, idx in ref_uids.items()
+    }
+
+
+def _armed_engine(cfg, params, fault_plan, **engine_kw):
+    """Arm `fault_plan` and build the engine-under-fault with its flight
+    recorder — the ONE construction point every scenario shares (each
+    fault kind used to re-spell this pair). Returns (eng, obs, armed)."""
+    faults.clear()
+    armed = faults.activate_plan(fault_plan)
+    obs = Observability()
+    eng = _engine(cfg, params, obs=obs, **engine_kw)
+    return eng, obs, armed
+
+
+def _run_scenario(obs, trace_dir, body):
+    """Run `body()` — the fault pass PLUS its invariant checks — under the
+    postmortem policy: dump the flight recorder into `trace_dir` when the
+    caller asked for one, and on ANY failure even without one (fresh temp
+    dir, path appended to the exception) so a broken invariant always
+    leaves a loadable trace. Returns body's summary with "trace" set."""
+    try:
+        summary = body()
+    except BaseException as e:
+        d = trace_dir or tempfile.mkdtemp(prefix="midgpt_chaos_postmortem_")
+        path = obs.dump(d)
+        e.args = tuple(
+            [f"{e.args[0]}\n[flight recorder: {path}]"] + list(e.args[1:])
+        ) if e.args else (f"[flight recorder: {path}]",)
+        raise
+    summary["trace"] = None if trace_dir is None else obs.dump(trace_dir)
+    return summary
+
+
+def _assert_drained_conserved(eng) -> int:
+    """Invariant 2 (+ serviceability): engine drained, every page either
+    free or retained by the trie with zero live references. Returns the
+    trie page count for the summary."""
+    assert eng.idle, "engine left work behind"
+    trie_pages = (
+        0 if eng.prefix_cache is None else eng.prefix_cache.page_count()
+    )
+    assert (
+        eng.allocator.free_count + trie_pages == eng.allocator.num_pages - 1
+    ), (
+        f"page leak: {eng.allocator.free_count} free + {trie_pages} trie of "
+        f"{eng.allocator.num_pages - 1} allocatable"
+    )
+    if eng.prefix_cache is not None:
+        dangling = eng.prefix_cache.referenced_page_count()
+        assert dangling == 0, f"{dangling} trie refcount(s) outlived the drain"
+    return trie_pages
+
+
 def run_serving_chaos(
     fault_plan: str, *, seed: int = 0, n_requests: int = 5,
     trace_dir: tp.Optional[str] = None,
@@ -183,10 +300,18 @@ def run_serving_chaos(
     `chaos_run.py --serve` emits as its JSON line. Raises AssertionError
     when a degradation invariant breaks — that IS the chaos verdict.
 
-    With `trace_dir`, the fault pass runs under a flight recorder
-    (midgpt_tpu/obs/) and dumps it there as a Chrome trace
-    (`flight_recorder.json` + `.prom` metrics) — the serving postmortem
-    artifact, written even when an invariant assertion fails."""
+    The fault pass always runs under a flight recorder (midgpt_tpu/obs/):
+    with `trace_dir` the Chrome trace + .prom metrics land there
+    unconditionally; without one they land in a temp dir only when an
+    invariant fails (the path rides the AssertionError)."""
+    if "hot_swap_mid_decode" in fault_plan:
+        return _run_hot_swap_chaos(
+            fault_plan, seed=seed, n_requests=n_requests, trace_dir=trace_dir
+        )
+    if "pool_resize" in fault_plan:
+        return _run_pool_resize_chaos(
+            fault_plan, seed=seed, n_requests=n_requests, trace_dir=trace_dir
+        )
     cfg, params = _tiny_model(seed)
     uses_server = "slow_client" in fault_plan
     uses_storm = "submit_storm" in fault_plan
@@ -196,105 +321,251 @@ def run_serving_chaos(
     uses_prefix = "evict_shared_prefix" in fault_plan
     trace = _trace(cfg, seed + 1, n_requests, shared=uses_prefix)
 
-    # Fault-free reference pass (also warms every jit shape, so the fault
-    # pass's timings/timeouts cannot hinge on compile stalls).
-    faults.clear()
-    ref = _engine(cfg, params, prefix=uses_prefix)
-    ref_uids, _ = _run_plain(ref, trace, storm=False)
-    ref_tokens = {
-        idx: np.asarray(ref.finished[uid].tokens)
-        for uid, idx in ref_uids.items()
-    }
-
-    faults.clear()
-    armed = faults.activate_plan(fault_plan)
-    # Only the FAULT pass is recorded: the reference pass must stay the
-    # untouched parity baseline, and the postmortem reader wants the trace
-    # of the run that went wrong, not the rehearsal.
-    obs = None if trace_dir is None else Observability()
-    eng = _engine(
-        cfg, params,
+    ref_tokens = _reference_pass(cfg, params, trace, prefix=uses_prefix)
+    eng, obs, armed = _armed_engine(
+        cfg, params, fault_plan,
         max_backlog_pages=STORM_BACKLOG_PAGES if uses_storm else None,
         prefix=uses_prefix,
-        obs=obs,
     )
-    delivered: tp.Optional[tp.Dict[int, tp.List[int]]] = None
-    storm_shed = 0
-    try:
+
+    def body() -> tp.Dict[str, tp.Any]:
+        delivered: tp.Optional[tp.Dict[int, tp.List[int]]] = None
+        storm_shed = 0
         if uses_server:
             uid_to_idx, delivered = _run_server(eng, trace)
         else:
             uid_to_idx, storm_shed = _run_plain(eng, trace, storm=uses_storm)
-    finally:
-        trace_path = None if obs is None else obs.dump(trace_dir)
-    fired = faults.fired_counts()
-    faults.clear()
+        fired = faults.fired_counts()
+        faults.clear()
 
-    # -- invariant 2: page conservation + engine still serviceable -------
-    # With the prefix cache on, pages the trie retains for future matches
-    # are accounted alongside the free list (every one of them must be
-    # unreferenced once the engine drains — a dangling refcount would be a
-    # leak in waiting).
-    assert eng.idle, "engine left work behind"
-    trie_pages = 0 if eng.prefix_cache is None else eng.prefix_cache.page_count()
-    conserved = (
-        eng.allocator.free_count + trie_pages == eng.allocator.num_pages - 1
-    )
-    assert conserved, (
-        f"page leak: {eng.allocator.free_count} free + {trie_pages} trie of "
-        f"{eng.allocator.num_pages - 1} allocatable"
-    )
-    if eng.prefix_cache is not None:
-        dangling = eng.prefix_cache.referenced_page_count()
-        assert dangling == 0, f"{dangling} trie refcount(s) outlived the drain"
+        _assert_drained_conserved(eng)
 
-    # -- invariant 3: unaffected greedy streams are bit-identical --------
-    affected = set(eng.poisoned_uids)
-    statuses: tp.Dict[str, int] = {}
-    parity_checked = parity_ok = 0
-    for uid, idx in uid_to_idx.items():
-        fr = eng.finished.get(uid)
-        assert fr is not None, f"request {uid} vanished"
-        statuses[fr.status] = statuses.get(fr.status, 0) + 1
-        if fr.status != "ok":
-            affected.add(uid)  # shed/timeout/slow_client: partial by design
-        if uid in affected:
-            continue
-        parity_checked += 1
-        if np.array_equal(np.asarray(fr.tokens), ref_tokens[idx]):
-            parity_ok += 1
-        if delivered is not None:
-            # What the client consumed must be a prefix of the reference
-            # generation — streaming may trail the engine, never diverge.
-            prompt_len = len(trace[idx][0])
-            got = np.asarray(delivered[uid], np.int32)
-            want = ref_tokens[idx][prompt_len:prompt_len + len(got)]
-            assert np.array_equal(got, want), (
-                f"delivered stream diverged for request {uid}"
-            )
-    assert parity_ok == parity_checked, (
-        f"greedy parity broke on {parity_checked - parity_ok} unaffected "
-        f"request(s)"
-    )
-    assert sum(fired.values()) >= min(1, len(armed)), "no armed fault fired"
+        # -- invariant 3: unaffected greedy streams are bit-identical ----
+        affected = set(eng.poisoned_uids)
+        statuses: tp.Dict[str, int] = {}
+        parity_checked = parity_ok = 0
+        for uid, idx in uid_to_idx.items():
+            fr = eng.finished.get(uid)
+            assert fr is not None, f"request {uid} vanished"
+            statuses[fr.status] = statuses.get(fr.status, 0) + 1
+            if fr.status != "ok":
+                affected.add(uid)  # shed/timeout/slow_client: partial by design
+            if uid in affected:
+                continue
+            parity_checked += 1
+            if np.array_equal(np.asarray(fr.tokens), ref_tokens[idx]):
+                parity_ok += 1
+            if delivered is not None:
+                # What the client consumed must be a prefix of the reference
+                # generation — streaming may trail the engine, never diverge.
+                prompt_len = len(trace[idx][0])
+                got = np.asarray(delivered[uid], np.int32)
+                want = ref_tokens[idx][prompt_len:prompt_len + len(got)]
+                assert np.array_equal(got, want), (
+                    f"delivered stream diverged for request {uid}"
+                )
+        assert parity_ok == parity_checked, (
+            f"greedy parity broke on {parity_checked - parity_ok} unaffected "
+            f"request(s)"
+        )
+        assert sum(fired.values()) >= min(1, len(armed)), "no armed fault fired"
 
-    return {
-        "mode": "serve",
-        "fault_plan": fault_plan,
-        "faults_fired": fired,
-        "n_requests": n_requests,
-        "statuses": statuses,
-        "shed": eng.shed + storm_shed,
-        "timeouts": eng.timeouts,
-        "cancelled": eng.cancelled,
-        "decode_kills": eng.decode_kills,
-        "preemptions": eng.preemptions,
-        "poisoned": len(eng.poisoned_uids),
-        "parity_checked": parity_checked,
-        "parity_ok": parity_ok,
-        "pages_conserved": conserved,
-        "prefix_cache": eng.prefix_cache is not None,
-        "prefix_reclaimed": eng.prefix_evictions,
-        "prefix_hit_rate": eng.prefix_stats()["hit_rate"],
-        "trace": trace_path,
+        return {
+            "mode": "serve",
+            "fault_plan": fault_plan,
+            "faults_fired": fired,
+            "n_requests": n_requests,
+            "statuses": statuses,
+            "shed": eng.shed + storm_shed,
+            "timeouts": eng.timeouts,
+            "cancelled": eng.cancelled,
+            "decode_kills": eng.decode_kills,
+            "preemptions": eng.preemptions,
+            "poisoned": len(eng.poisoned_uids),
+            "parity_checked": parity_checked,
+            "parity_ok": parity_ok,
+            "pages_conserved": True,
+            "prefix_cache": eng.prefix_cache is not None,
+            "prefix_reclaimed": eng.prefix_evictions,
+            "prefix_hit_rate": eng.prefix_stats()["hit_rate"],
+        }
+
+    return _run_scenario(obs, trace_dir, body)
+
+
+# -- model-ops scenarios (sampling/ops.py) ---------------------------------
+
+
+def _verified_swap_weights(cfg, seed: int, root_dir: str):
+    """Fresh weights through the REAL verified-checkpoint path: init at a
+    different seed, save with the manifest-stamping CheckpointManager,
+    restore via `restore_for_sampling`'s latest-verified-step path — the
+    exact pipeline a production deploy would hand the hot-swap. Returns
+    (restored params, step, "<step>:<sha12>" weights version)."""
+    import os
+    import types
+
+    import jax
+
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.sampling.engine import restore_for_sampling
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    ckpt_dir = os.path.join(root_dir, "swap_ckpt")
+    mgr = CheckpointManager(ckpt_dir, save_interval_steps=1)
+    mgr.save(7, {"params": GPT.init(cfg, jax.random.PRNGKey(seed + 101))},
+             force=True)
+    mgr.wait()
+    version = mgr.weights_version(7)
+    mgr.close()
+    assert version is not None, "manifest missing after save barrier"
+    # fsdp_min_size past any leaf size -> fully replicated shardings, which
+    # stage_hot_swap then re-homes onto the live engine's own layout.
+    shim = types.SimpleNamespace(
+        model_config=cfg, fsdp_min_size=1 << 60, param_dtype="float32"
+    )
+    restored, step = restore_for_sampling(ckpt_dir, shim)
+    return restored, step, version
+
+
+def _run_hot_swap_chaos(
+    fault_plan: str, *, seed: int, n_requests: int,
+    trace_dir: tp.Optional[str],
+) -> tp.Dict[str, tp.Any]:
+    """Blue/green weight flip mid-trace (module docstring): three passes —
+    fault-free on the OLD weights, fault-free on the NEW (restored)
+    weights, then the fault pass — and a per-stream parity check against
+    whichever side of the flip served it (`served_uids_at_flip`). Pinned
+    by tests/test_chaos_serve.py::
+    test_chaos_hot_swap_mid_decode_blue_green_parity."""
+    cfg, params_old = _tiny_model(seed)
+    root = trace_dir or tempfile.mkdtemp(prefix="midgpt_chaos_swap_")
+    params_new, step, version = _verified_swap_weights(cfg, seed, root)
+    trace = _trace(cfg, seed + 1, n_requests)
+
+    ref_old = _reference_pass(cfg, params_old, trace)
+    ref_new = _reference_pass(cfg, params_new, trace)
+    eng, obs, armed = _armed_engine(cfg, params_old, fault_plan)
+    eng.swap_source = lambda: {
+        "params": params_new, "version": version, "config": cfg,
     }
+
+    def body() -> tp.Dict[str, tp.Any]:
+        uid_to_idx = _run_trickle(eng, trace)
+        fired = faults.fired_counts()
+        faults.clear()
+        assert sum(fired.values()) >= min(1, len(armed)), "no armed fault fired"
+        assert eng.hot_swaps == 1, f"swap never flipped ({eng.hot_swaps=})"
+        assert eng.weights_version == version, (
+            f"weights_version {eng.weights_version!r} != {version!r}"
+        )
+        _assert_drained_conserved(eng)
+
+        swap = eng.swap_history[0]
+        old_uids = set(swap["served_uids_at_flip"])
+        statuses: tp.Dict[str, int] = {}
+        parity = {"old": 0, "new": 0}
+        for uid, idx in uid_to_idx.items():
+            fr = eng.finished.get(uid)
+            assert fr is not None, f"request {uid} dropped across the flip"
+            statuses[fr.status] = statuses.get(fr.status, 0) + 1
+            assert fr.status == "ok", (
+                f"request {uid} degraded to {fr.status!r} — a hot swap must "
+                "drop zero streams"
+            )
+            side = "old" if uid in old_uids else "new"
+            want = (ref_old if side == "old" else ref_new)[idx]
+            assert np.array_equal(np.asarray(fr.tokens), want), (
+                f"greedy parity broke for request {uid} ({side}-weights side "
+                "of the flip)"
+            )
+            parity[side] += 1
+        assert parity["old"] and parity["new"], (
+            f"flip landed outside the trace ({parity}) — tune the fault round"
+        )
+        return {
+            "mode": "serve",
+            "fault_plan": fault_plan,
+            "faults_fired": fired,
+            "n_requests": n_requests,
+            "statuses": statuses,
+            "weights_version": eng.weights_version,
+            "checkpoint_step": step,
+            "swap": {
+                "staged_round": swap["staged_round"],
+                "flip_round": swap["flip_round"],
+                "in_flight_at_stage": len(swap["in_flight_at_stage"]),
+                "swap_latency_s": swap["swap_latency_s"],
+            },
+            "parity_old_side": parity["old"],
+            "parity_new_side": parity["new"],
+            "dropped": 0,
+            "pages_conserved": True,
+        }
+
+    return _run_scenario(obs, trace_dir, body)
+
+
+def _run_pool_resize_chaos(
+    fault_plan: str, *, seed: int, n_requests: int,
+    trace_dir: tp.Optional[str],
+) -> tp.Dict[str, tp.Any]:
+    """Grow-then-shrink pool resize mid-trace (module docstring), on an
+    int8 cache so the scale side buffers must migrate with their pages:
+    conservation at every boundary and EVERY stream bit-identical to the
+    no-resize reference — a resize affects nobody."""
+    import jax.numpy as jnp
+
+    cfg, params = _tiny_model(seed)
+    trace = _trace(cfg, seed + 1, n_requests)
+
+    ref_tokens = _reference_pass(cfg, params, trace, cache_dtype=jnp.int8)
+    eng, obs, armed = _armed_engine(cfg, params, fault_plan,
+                                    cache_dtype=jnp.int8)
+    eng.resize_plan = list(RESIZE_TARGETS)
+
+    def body() -> tp.Dict[str, tp.Any]:
+        # Trickle arrivals: the grow-then-shrink plan spans two fault
+        # rounds, so the trace must still be live at BOTH (upfront
+        # submission can drain a small trace before the shrink round).
+        uid_to_idx = _run_trickle(eng, trace)
+        fired = faults.fired_counts()
+        faults.clear()
+        n_fired = sum(fired.values())
+        assert n_fired >= min(1, len(armed)), "no armed fault fired"
+        # resize_pool asserts conservation before AND after each migration;
+        # this is the post-drain re-check.
+        assert eng.resizes == n_fired, (
+            f"{n_fired} pool_resize firings but {eng.resizes} resizes"
+        )
+        _assert_drained_conserved(eng)
+        assert eng.cache.quantized and eng.cache.k_scale is not None
+
+        statuses: tp.Dict[str, int] = {}
+        parity_ok = 0
+        for uid, idx in uid_to_idx.items():
+            fr = eng.finished.get(uid)
+            assert fr is not None, f"request {uid} dropped across a resize"
+            statuses[fr.status] = statuses.get(fr.status, 0) + 1
+            assert np.array_equal(np.asarray(fr.tokens), ref_tokens[idx]), (
+                f"greedy parity broke for request {uid} across a live resize"
+            )
+            parity_ok += 1
+        return {
+            "mode": "serve",
+            "fault_plan": fault_plan,
+            "faults_fired": fired,
+            "n_requests": n_requests,
+            "statuses": statuses,
+            "cache_dtype": "int8",
+            "resizes": eng.resize_history,
+            "pages_migrated": sum(
+                r["pages_migrated"] for r in eng.resize_history
+            ),
+            "final_num_pages": eng.allocator.num_pages,
+            "parity_checked": parity_ok,
+            "parity_ok": parity_ok,
+            "pages_conserved": True,
+        }
+
+    return _run_scenario(obs, trace_dir, body)

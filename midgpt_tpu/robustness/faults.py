@@ -57,6 +57,18 @@ firing deterministic):
                      bit-identical while later requests just re-prefill
                      and re-populate the trie, with pages + refcounts
                      conserved through the flush.
+  hot_swap_mid_decode  stage a blue/green weight swap mid-trace (payload
+                     from the engine's `swap_source` hook): admissions
+                     pause, in-flight streams finish on the old weights
+                     bit-exactly, queued arrivals take the new ones, zero
+                     streams dropped, pool + trie conserved across the
+                     flip (sampling/ops.py).
+  pool_resize        live-resize the paged KV pool to the next target on
+                     the engine's `resize_plan` (grow then shrink in the
+                     chaos gate): resident pages migrate through the
+                     adoption scatter with int8 scales, conservation
+                     holds at every boundary, and live streams stay
+                     greedy-bit-exact vs a no-resize run.
 
 Activation: programmatic (`activate(...)`), or a plan string from config
 (`ExperimentConfig.fault_plan`) / the MIDGPT_FAULTS env var, parsed by
@@ -84,7 +96,27 @@ KINDS = (
     "slow_client",
     "submit_storm",
     "evict_shared_prefix",
+    "hot_swap_mid_decode",
+    "pool_resize",
 )
+
+# One-line summaries for operator tooling (`tools/chaos_run.py --serve
+# --list-faults` and unknown-fault diagnostics). The module docstring above
+# stays the full contract; this is the discoverable index of it.
+DESCRIPTIONS: tp.Dict[str, str] = {
+    "nan_grad": "poison the train step's loss at data step k (bad batch)",
+    "ckpt_io_error": "raise IOError from the next checkpoint-save attempts",
+    "kill_mid_save": "truncate one ckpt item + die before the manifest lands",
+    "truncate_ckpt_item": "corrupt one ckpt item AFTER its manifest committed",
+    "preempt": "set the preemption flag at data step k (SIGTERM mid-step)",
+    "kill_mid_decode": "the round's decode dispatch dies; slots recompute-preempt",
+    "poisoned_page": "corrupt one live slot's pool page in place (HBM damage)",
+    "slow_client": "a streaming client stops draining; bounded buffer sheds it",
+    "submit_storm": "submission burst beyond the backpressure budget; excess sheds",
+    "evict_shared_prefix": "force-flush every unreferenced prefix-trie page at once",
+    "hot_swap_mid_decode": "blue/green weight swap mid-trace (engine swap_source)",
+    "pool_resize": "live KV pool resize to the engine's next resize_plan target",
+}
 
 _PLAN_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$")
 

@@ -226,6 +226,8 @@ class DisaggServe:
         # Handoffs that could not get decode-pool pages and fell back to
         # plain re-prefill on the decode role (correct, just slower).
         self.fallback_reprefills = 0
+        # prefill<->decode pool-capacity moves (ops.py re-role decisions)
+        self.re_roles = 0
 
     # -- public surface ------------------------------------------------
 
@@ -281,6 +283,7 @@ class DisaggServe:
         return {
             "queue": self.queue.stats(),
             "fallback_reprefills": self.fallback_reprefills,
+            "re_roles": self.re_roles,
             "prefill": self.prefill.stats(),
             "decode": self.decode.stats(),
             # shared across both roles (one Observability, two tid lanes)
@@ -288,6 +291,33 @@ class DisaggServe:
                 DISABLED_SNAPSHOT if self.obs is None else self.obs.snapshot()
             ),
         }
+
+    def rebalance(self, n_pages: int, *, src: str = "prefill",
+                  dst: str = "decode") -> tp.Dict[str, tp.Any]:
+        """Move `n_pages` of pool capacity from the `src` role to the
+        `dst` role via two live resizes (sampling/ops.py resize_pool) —
+        the re-role actuator of the model-ops policy loop. Shrink-first:
+        if the src role cannot give the pages up without dropping its
+        resident working set, the retryable PoolResizeError propagates
+        BEFORE anything changed; the dst grow that follows cannot fail.
+        Each role keeps its own pool and devices — re-roling moves page
+        BUDGET, not pages in flight (those still cross on the handoff
+        queue's adoption scatter)."""
+        roles = {"prefill": self.prefill, "decode": self.decode}
+        if src not in roles or dst not in roles or src == dst:
+            raise ValueError(f"rebalance src/dst must be distinct roles "
+                             f"from {sorted(roles)}, got {src!r}->{dst!r}")
+        if n_pages < 1:
+            raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+        shrink = roles[src].resize(roles[src].allocator.num_pages - n_pages)
+        grow = roles[dst].resize(roles[dst].allocator.num_pages + n_pages)
+        self.re_roles += 1
+        self._trace.instant(
+            "ops.re_role", "ops", "disagg",
+            args={"src": src, "dst": dst, "pages": n_pages},
+        )
+        return {"src": src, "dst": dst, "pages": n_pages,
+                "src_resize": shrink, "dst_resize": grow}
 
     # -- internals -----------------------------------------------------
 

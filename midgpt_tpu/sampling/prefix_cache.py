@@ -321,6 +321,25 @@ class PrefixCache:
     def pages_held(self) -> tp.Set[int]:
         return {e.page for e in self._iter_entries()}
 
+    def referenced_pages(self) -> tp.Set[int]:
+        """Physical pages with at least one live reader — the part of the
+        trie's footprint a live pool resize must carry over (resident
+        working set, sampling/ops.py resize_pool)."""
+        return {e.page for e in self._iter_entries() if e.refs > 0}
+
+    def remap_pages(self, mapping: tp.Mapping[int, int]) -> int:
+        """Rewrite every entry's physical page id through `mapping` — the
+        trie re-seed step of a live pool resize (sampling/ops.py): the
+        token->content structure and all refcounts survive; only the
+        physical addressing changes, in lockstep with the slot page lists
+        and the migrated pool. Every held page must be in `mapping`
+        (resize migrates the full resident set). Returns entries remapped."""
+        n = 0
+        for e in self._iter_entries():
+            e.page = mapping[e.page]
+            n += 1
+        return n
+
     def stats(self) -> tp.Dict[str, int]:
         ents = list(self._iter_entries())
         return {

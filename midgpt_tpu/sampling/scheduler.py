@@ -187,3 +187,15 @@ class SLOScheduler(Scheduler):
                 False,  # waiting cannot make a past-due deadline feasible
             )
         return None
+
+
+def set_backlog_budget(engine, pages: tp.Optional[int]) -> tp.Optional[int]:
+    """Retune the engine's `max_backlog_pages` shed threshold live (None
+    disables the budget). This is the shed-threshold actuator of the
+    model-ops policy loop (sampling/ops.py ModelOps): the budget is pure
+    host-side admission state, so moving it never touches a compiled
+    program — the same guarantee as swapping scheduler policies. Returns
+    the previous budget."""
+    prev = engine.max_backlog_pages
+    engine.max_backlog_pages = pages
+    return prev

@@ -475,6 +475,7 @@ class ServeEngine:
         mesh=None,  # Optional[jax.sharding.Mesh] — parallel/serve_tp.py
         obs: tp.Optional[Observability] = None,
         obs_tid: str = "engine",
+        weights_version: str = "inline",
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
         # ---- tp serving mesh (docs/SERVING.md "Mesh-sharded serving") ----
@@ -684,6 +685,32 @@ class ServeEngine:
         # the slots a chaos parity check must exclude (everyone else's
         # stream never reads the poisoned physical page).
         self.poisoned_uids: tp.List[int] = []
+        # ---- zero-downtime model ops (sampling/ops.py) ----------------
+        # weights_version identifies which weights serve each round on
+        # stats() and flight-recorder dumps: "<step>:<sha12>" for verified
+        # checkpoints (training/checkpoint.py weights_version) or "inline"
+        # for directly-passed params. A staged blue/green swap pauses
+        # admissions (so queued arrivals deterministically take the NEW
+        # weights) and flips at the first slot-free round boundary.
+        self.weights_version = weights_version
+        self.hot_swaps = 0
+        self.resizes = 0
+        self.swap_history: tp.List[tp.Dict[str, tp.Any]] = []
+        self.resize_history: tp.List[tp.Dict[str, tp.Any]] = []
+        self._staged_swap: tp.Optional[tp.Dict[str, tp.Any]] = None
+        # Uids that have been recompute-preempted at least once: a queued
+        # entry with one of these uids is a stream ALREADY in flight (its
+        # early tokens are committed), not a fresh arrival — the staged-
+        # swap admission pause must let it resume on the old weights, and
+        # the flip must wait for it (sampling/ops.py). Uids are never
+        # reused, so the set is grow-only.
+        self._resumed_uids: tp.Set[int] = set()
+        # Chaos hooks (robustness/chaos_serve.py): hot_swap_mid_decode
+        # pulls its payload from swap_source (a callable returning
+        # hot_swap kwargs incl. "params"); pool_resize pops its next
+        # num_pages target from resize_plan. Both None/empty in production.
+        self.swap_source: tp.Optional[tp.Callable[[], tp.Dict[str, tp.Any]]] = None
+        self.resize_plan: tp.List[int] = []
 
     # -- public surface ------------------------------------------------
 
@@ -770,7 +797,14 @@ class ServeEngine:
 
     @property
     def idle(self) -> bool:
-        return not self.queue and all(s is None for s in self.slots)
+        # A staged hot-swap counts as pending work: the drive loop must
+        # keep stepping until the flip lands (sampling/ops.py), or a swap
+        # staged on a draining engine would never complete.
+        return (
+            not self.queue
+            and all(s is None for s in self.slots)
+            and self._staged_swap is None
+        )
 
     def run(self) -> tp.Dict[int, FinishedRequest]:
         """Drive step() until everything submitted so far has finished."""
@@ -817,6 +851,58 @@ class ServeEngine:
                 self.slots[i] = None
                 return True
         return False
+
+    def hot_swap(
+        self,
+        params: GPTParams,
+        *,
+        draft_params: tp.Optional[GPTParams] = None,
+        version: str = "inline",
+        config: tp.Optional[GPTConfig] = None,
+    ) -> tp.Dict[str, tp.Any]:
+        """Stage a blue/green weight swap; flips at the first slot-free
+        round boundary (immediately when idle). Same-shape swaps compile
+        ZERO new programs; mismatches raise a structured HotSwapError
+        before anything changes. Full protocol: sampling/ops.py,
+        docs/ROBUSTNESS.md "Zero-downtime model ops"."""
+        from midgpt_tpu.sampling import ops as _ops
+
+        return _ops.stage_hot_swap(
+            self, params, draft_params=draft_params, version=version,
+            config=config,
+        )
+
+    def resize(
+        self,
+        num_pages: tp.Optional[int] = None,
+        *,
+        max_slots: tp.Optional[int] = None,
+    ) -> tp.Dict[str, tp.Any]:
+        """Live pool resize: migrate the resident working set into a fresh
+        `num_pages` pool (int8 scales ride along), remap slots + trie, and
+        install a new allocator. Shrinking below the resident working set
+        raises a retryable PoolResizeError instead of dropping live data
+        (sampling/ops.py)."""
+        from midgpt_tpu.sampling import ops as _ops
+
+        return _ops.resize_pool(self, num_pages, max_slots=max_slots)
+
+    def _hot_swap_fault(self) -> None:
+        """The `hot_swap_mid_decode` chaos fault: stage whatever weights
+        the scenario registered on `swap_source` at this round boundary —
+        the production swap path end to end, just triggered by the fault
+        registry instead of an operator (robustness/chaos_serve.py)."""
+        if self.swap_source is None:
+            return
+        payload = dict(self.swap_source())
+        self.hot_swap(payload.pop("params"), **payload)
+
+    def _pool_resize_fault(self) -> None:
+        """The `pool_resize` chaos fault: resize to the next target on
+        `resize_plan` (e.g. [43, 37] for a grow-then-shrink gate)."""
+        if not self.resize_plan:
+            return
+        self.resize(self.resize_plan.pop(0))
 
     def cache_hbm_bytes(self) -> int:
         """Total device bytes of the target pool — K/V pages plus, in int8
@@ -873,6 +959,10 @@ class ServeEngine:
             "timeouts": self.timeouts,
             "shed": self.shed,
             "cancelled": self.cancelled,
+            "weights_version": self.weights_version,
+            "hot_swaps": self.hot_swaps,
+            "resizes": self.resizes,
+            "swap_pending": self._staged_swap is not None,
             "compile_counts": self.compile_stats(),
             # unified observability schema (docs/OBSERVABILITY.md): round
             # decomposition + metrics when an Observability is wired in,
@@ -902,8 +992,20 @@ class ServeEngine:
         if faults.should_fire("evict_shared_prefix", step=self.rounds):
             tr.instant("fault.evict_shared_prefix", "fault", self._obs_tid)
             self._evict_shared_prefix_fault()
+        if faults.should_fire("hot_swap_mid_decode", step=self.rounds):
+            tr.instant("fault.hot_swap_mid_decode", "fault", self._obs_tid)
+            self._hot_swap_fault()
+        if faults.should_fire("pool_resize", step=self.rounds):
+            tr.instant("fault.pool_resize", "fault", self._obs_tid)
+            self._pool_resize_fault()
         with tr.span("engine.expire", "phase", self._obs_tid):
             self._expire_round()
+        if self._staged_swap is not None:
+            # Blue/green flip point: after expiry (slots may have just
+            # drained), before admission (which is paused while staged).
+            from midgpt_tpu.sampling import ops as _ops
+
+            _ops.maybe_flip_swap(self)
         with tr.span("engine.admit", "phase", self._obs_tid):
             self._admit()
         with tr.span("engine.prefill", "phase", self._obs_tid):
@@ -1047,9 +1149,23 @@ class ServeEngine:
         now = self._clock()
         for i, s in enumerate(self.slots):
             if s is None and self.queue:
-                # Admission ORDER is the scheduler's call (FCFS: the queue
-                # head; SLO: earliest deadline first).
-                qi = self.scheduler.select_admit(self.queue, now)
+                if self._staged_swap is not None:
+                    # A staged hot-swap pauses FRESH admissions (queued
+                    # arrivals deterministically take the new weights), but
+                    # a recompute-preempted stream is old-side work already
+                    # in flight: it must resume on the old weights, both so
+                    # its committed tokens never straddle the flip and so
+                    # the drain the flip waits for can complete at all
+                    # (sampling/ops.py).
+                    qi = next(
+                        (j for j, q in enumerate(self.queue)
+                         if q.uid in self._resumed_uids),
+                        None,
+                    )
+                else:
+                    # Admission ORDER is the scheduler's call (FCFS: the
+                    # queue head; SLO: earliest deadline first).
+                    qi = self.scheduler.select_admit(self.queue, now)
                 if qi is None:
                     break
                 req = self.queue.pop(qi)
@@ -1159,6 +1275,7 @@ class ServeEngine:
         self._release_slot(victim)
         self.slots[i] = None
         self.preemptions += 1
+        self._resumed_uids.add(req.uid)
         self._trace.instant(
             "preempt", "lifecycle", self._obs_tid, args={"uid": req.uid}
         )

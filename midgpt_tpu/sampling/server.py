@@ -306,12 +306,53 @@ class AsyncServeServer:
             "free_pages": eng.allocator.free_count,
             "prefix": eng.prefix_stats(),
             "mesh": eng.mesh_shape(),
+            "weights_version": eng.weights_version,
+            "hot_swaps": eng.hot_swaps,
+            "resizes": eng.resizes,
+            "swap_pending": eng._staged_swap is not None,
             # same unified schema as ServeEngine.stats()["obs"]
             # (docs/OBSERVABILITY.md): round decomposition + metrics
             "obs": (
                 DISABLED_SNAPSHOT if eng.obs is None else eng.obs.snapshot()
             ),
         }
+
+    async def hot_swap(
+        self,
+        params,
+        *,
+        draft_params=None,
+        version: str = "inline",
+        config=None,
+    ) -> tp.Dict[str, tp.Any]:
+        """Stage a blue/green weight swap on the driver loop (the same
+        command funnel as submit/cancel, so the stage lands between engine
+        rounds, never mid-round). Returns the stage summary; the flip
+        itself happens at the first slot-free round boundary and shows up
+        on stats() as the new `weights_version`. Structured HotSwapError
+        on shape/config mismatch (sampling/ops.py)."""
+
+        def do_swap() -> tp.Dict[str, tp.Any]:
+            return self.engine.hot_swap(
+                params, draft_params=draft_params, version=version,
+                config=config,
+            )
+
+        return await self._call(do_swap)
+
+    async def resize(
+        self,
+        num_pages: tp.Optional[int] = None,
+        *,
+        max_slots: tp.Optional[int] = None,
+    ) -> tp.Dict[str, tp.Any]:
+        """Live pool resize on the driver loop; retryable PoolResizeError
+        when shrinking below the resident working set (sampling/ops.py)."""
+
+        def do_resize() -> tp.Dict[str, tp.Any]:
+            return self.engine.resize(num_pages, max_slots=max_slots)
+
+        return await self._call(do_resize)
 
     async def drain(self) -> None:
         """Stop admission and wait for every in-flight request to finish.

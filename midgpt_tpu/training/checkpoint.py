@@ -217,6 +217,24 @@ class CheckpointManager:
     def verified_steps(self) -> tp.List[int]:
         return [s for s in self.all_steps() if self.is_verified(s)]
 
+    def weights_version(self, step: int) -> tp.Optional[str]:
+        """'<step>:<sha12>' identity of a step's committed manifest — the
+        value serving surfaces as `weights_version` on stats()/loadgen
+        lines so every round is attributable to exactly one verified
+        checkpoint (sampling/ops.py hot-swap; "inline" means params were
+        passed directly). Hashing the manifest FILE (which already records
+        per-item sha256s) gives a stable content identity without
+        re-hashing tensor bytes. None when the step has no manifest."""
+        d = self._step_dir(step)
+        if d is None:
+            return None
+        path = os.path.join(d, MANIFEST_NAME)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as fh:
+            digest = hashlib.sha256(fh.read()).hexdigest()
+        return f"{step}:{digest[:12]}"
+
     def latest_verified_step(self) -> tp.Optional[int]:
         """Newest step whose manifest verifies — the only safe resume point.
 

@@ -10,10 +10,13 @@ import os
 import runpy
 import sys
 
+import pytest
+
 from midgpt_tpu.analysis.bench_contract import (
     check_bench_stdout,
     check_serve_bench,
     check_serve_longctx_bench,
+    check_serve_ops_bench,
     check_serve_prefix_bench,
     check_serve_slo_bench,
     check_serve_tp_bench,
@@ -38,6 +41,7 @@ def _run_entry_point(path, argv, capsys):
     return capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_bench_serve_emits_conformant_json_line(capsys):
     out = _run_entry_point(
         os.path.join(REPO, "tools", "bench_serve.py"),
@@ -139,6 +143,7 @@ def test_bench_serve_prefix_emits_conformant_json_line(capsys):
     )
 
 
+@pytest.mark.slow
 def test_bench_serve_tp_emits_conformant_json_line(capsys):
     """--tp mode: the serve_tp profile (single-chip vs tensor-parallel
     engine per cache mode) must hold the one-JSON-line contract with every
@@ -182,6 +187,7 @@ def test_bench_serve_tp_emits_conformant_json_line(capsys):
     )
 
 
+@pytest.mark.slow
 def test_bench_serve_longctx_emits_conformant_json_line(capsys):
     """--long-ctx mode: the serve_longctx profile (split-K decode A/B at a
     long and a short context) must hold the one-JSON-line contract with
@@ -236,6 +242,94 @@ def test_bench_serve_longctx_emits_conformant_json_line(capsys):
         "ms_round_long_split" in p
         for p in check_serve_longctx_bench(dict(rec, ms_round_long_split=0.0))
     )
+
+
+def test_bench_serve_ops_emits_conformant_json_line(capsys):
+    """--hot-swap mode: the serve_ops profile (verified-checkpoint
+    blue/green swap mid-trace + live pool grow) must hold the one-JSON-
+    line contract with zero dropped streams, a zero swap-window jit-cache
+    delta, both parity sides non-empty and summing to n_requests, and a
+    non-vacuous migration. Tiny shapes — structure check; the full-size
+    run is the driver's serve_ops gate (docs/ROBUSTNESS.md)."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--hot-swap",
+            "--n-requests", "8",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_ops")
+    assert not problems, problems
+    assert rec["dropped"] == 0 and rec["swap_recompiles"] == 0
+    assert rec["parity_old_side"] >= 1 and rec["parity_new_side"] >= 1
+    assert rec["parity_old_side"] + rec["parity_new_side"] == 8
+    assert rec["weights_version_after"].startswith(
+        f"{rec['checkpoint_step']}:"
+    )
+    assert rec["pages_migrated"] >= 1 and rec["pages_conserved"] is True
+    # checker drift behavior on the real record: a dropped stream, a swap
+    # recompile, a vacuous parity side, and an unchanged version are each
+    # contract violations, not numbers
+    assert any("dropped" in p for p in check_serve_ops_bench(dict(rec, dropped=1)))
+    assert any(
+        "swap_recompiles" in p
+        for p in check_serve_ops_bench(dict(rec, swap_recompiles=2))
+    )
+    assert any(
+        "parity" in p
+        for p in check_serve_ops_bench(
+            dict(rec, parity_old_side=0,
+                 parity_new_side=rec["n_requests"])
+        )
+    )
+    assert any(
+        "weights_version" in p
+        for p in check_serve_ops_bench(
+            dict(rec, weights_version_after=rec["weights_version_before"])
+        )
+    )
+    assert any(
+        "pages_migrated" in p
+        for p in check_serve_ops_bench(dict(rec, pages_migrated=0))
+    )
+
+
+@pytest.mark.slow
+def test_loadgen_hot_swap_surfaces_version_transition(capsys):
+    """tools/loadgen.py --hot-swap: the serve_slo line still conforms, a
+    swap lands at every point, the headline carries the version
+    transition, and the SLO acceptance (zero shed through the swap on an
+    unbounded backlog) holds with no special-casing."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "loadgen.py"),
+        [
+            "loadgen.py",
+            "--rates", "30,90",
+            "--n-requests", "4",
+            "--hot-swap",
+            "--seed", "0",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_slo")
+    assert not problems, problems
+    assert rec["hot_swaps"] == 2  # one flip per point
+    assert rec["weights_versions"][0] == "inline"
+    assert rec["weights_versions"][1].startswith("3:")
+    for p in rec["points"]:
+        assert p["hot_swaps"] == 1
+        assert p["weights_version"] == rec["weights_versions"][1]
+        assert p["shed"] == 0 and p["completed"] == p["n_offered"]
+    assert rec["slo_ok"] is True
 
 
 def test_loadgen_prefix_cache_emits_hit_rate(capsys):

@@ -87,6 +87,47 @@ def test_chaos_evict_shared_prefix_flush_never_corrupts_readers():
     assert s["pages_conserved"]
 
 
+def test_chaos_hot_swap_mid_decode_blue_green_parity():
+    """The zero-downtime swap gate (docs/ROBUSTNESS.md 'Zero-downtime
+    model ops'): a verified-checkpoint blue/green weight swap lands mid-
+    trace with trickle arrivals. Zero streams drop; streams served before
+    the flip are bit-identical to the fault-free OLD-weights pass, post-
+    flip admissions to the NEW-weights pass; both sides non-empty; pages
+    conserved through the flip."""
+    s = run_serving_chaos("hot_swap_mid_decode@5", seed=0)
+    assert s["faults_fired"] == {"hot_swap_mid_decode": 1}
+    assert s["statuses"] == {"ok": s["n_requests"]}
+    assert s["dropped"] == 0
+    # a REAL verified version: "<step>:<sha12>" from the manifest hash
+    step = s["checkpoint_step"]
+    assert s["weights_version"].startswith(f"{step}:")
+    assert len(s["weights_version"].split(":")[1]) == 12
+    assert s["swap"]["flip_round"] >= s["swap"]["staged_round"]
+    assert s["parity_old_side"] >= 1 and s["parity_new_side"] >= 1
+    assert s["parity_old_side"] + s["parity_new_side"] == s["n_requests"]
+    assert s["pages_conserved"]
+
+
+def test_chaos_pool_resize_grow_shrink_int8_parity():
+    """The elastic-resize gate: grow then shrink mid-trace on an int8
+    cache (scales must migrate with their pages or parity breaks). Every
+    stream stays greedy-bit-exact vs the no-resize reference; page
+    conservation holds at every boundary (asserted inside resize_pool on
+    both sides of each migration)."""
+    s = run_serving_chaos("pool_resize@4,pool_resize@8", seed=0)
+    assert s["faults_fired"] == {"pool_resize": 2}
+    assert s["cache_dtype"] == "int8"
+    assert len(s["resizes"]) == 2
+    grow, shrink = s["resizes"]
+    assert grow["to_pages"] > grow["from_pages"]
+    assert shrink["to_pages"] < shrink["from_pages"]
+    assert s["final_num_pages"] == shrink["to_pages"]
+    assert s["pages_migrated"] >= 1
+    assert s["statuses"] == {"ok": s["n_requests"]}
+    assert s["parity_ok"] == s["parity_checked"] == s["n_requests"]
+    assert s["pages_conserved"]
+
+
 def test_chaos_run_serve_cli_emits_one_json_line(capsys):
     """`chaos_run.py --serve` holds the one-JSON-line driver contract and
     carries the chaos verdict fields."""

@@ -196,6 +196,7 @@ def test_moe_aux_loss_value_and_balance():
     assert float(jnp.abs(g).max()) > 0  # pressure flows through P_e
 
 
+@pytest.mark.slow
 def test_moe_aux_coef_zero_impact_when_disabled():
     """ISSUE satellite pin: with moe_aux_coef=0.0 (default) the train-step
     loss is EXACTLY the CE loss (the aux term is never requested, so it

@@ -192,6 +192,7 @@ def _run_engine(params, trace, **kw):
     return eng, [done[u].tokens for u in uids]
 
 
+@pytest.mark.slow
 def test_int8_serving_deterministic_under_eviction(params):
     """The PR-1 decode-time-eviction regression scenario, quantized: an
     oversubscribed int8 pool forces recompute-style preemption mid-decode
@@ -221,6 +222,7 @@ def test_int8_serving_deterministic_under_eviction(params):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_int8_spec_greedy_matches_plain_int8(params):
     """Greedy speculative serving on the int8 cache == greedy plain int8
     serving, token for token: the draft's speculative writes and the
@@ -270,6 +272,7 @@ def test_int8_stochastic_serving_runs(params):
 # ----------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_byte_budget_doubles_pages_and_reduces_preemptions(params):
     """THE capacity claim: at a fixed pool_hbm_bytes, the int8 pool admits
     exactly 2x the pages of bf16 (the budget covers the K/V pools;

@@ -271,6 +271,117 @@ def test_obs_toggle_compiles_zero_new_programs(params):
     assert eng.stats()["obs"]["round_decomp"]["rounds"] > 0
 
 
+def test_hot_swap_and_ops_ticks_compile_zero_new_programs(params):
+    """Tentpole pin (model-ops PR): a same-shape blue/green hot-swap is a
+    pointer flip — the candidate params are device_put onto the LIVE
+    params' shardings and params are traced args of every serving jit, so
+    the swap compiles NOTHING; obs-on ModelOps controller ticks are pure
+    host reads (allocator counters, backlog arithmetic) and also compile
+    nothing. Warm-then-count on a fresh 51-page pool: the identical
+    two-wave schedule runs once swap-free to warm every program this
+    geometry reaches, then twice with the swap and the controller live
+    under a CompileCounter. All three submissions land in slots before
+    the swap stages, so the admission pause cannot alter the schedule."""
+    from midgpt_tpu.obs import Observability
+    from midgpt_tpu.sampling.ops import ModelOps
+
+    # COMMITTED initial params (like a restored engine's): the staged
+    # candidate is device_put onto the live shardings, and a committed
+    # vs uncommitted input is a distinct executable key — an engine
+    # born from uncommitted arrays would recompile once on the first
+    # swap for that reason alone, not because of the swap protocol.
+    params_a = jax.device_put(params, jax.devices()[0])
+    params_b = GPT.init(CFG, jax.random.PRNGKey(7))
+
+    def mix(swap, seed):
+        eng = ServeEngine(
+            CFG, params_a, max_slots=3, page_size=8, num_pages=51,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32, obs=Observability(),
+        )
+        mops = ModelOps(eng, clock=lambda: 0.0, apply=False)
+        rng = np.random.default_rng(seed)
+        for wave in range(2):
+            uids = {
+                eng.submit(
+                    rng.integers(0, CFG.vocab_size, n).astype(np.int32), m
+                )
+                for n, m in zip((25, 34, 47), (9, 17, 17))
+            }
+            for _ in range(3):
+                eng.step()
+            mops.tick()  # advisory mid-wave tick: host-only
+            if swap:
+                eng.hot_swap(params_b, version=f"v{wave}")
+            done = eng.run()  # drains the wave; a staged swap flips here
+            assert uids <= set(done)
+        mops.tick()
+        return eng
+
+    mix(False, seed=0)  # warm every program this geometry/schedule reaches
+    d0 = jit_cache_size(_serve_decode_chunk)
+    p0 = jit_cache_size(_serve_prefill_chunk)
+    eng = mix(True, seed=0)  # same trace, swap + controller live: the
+    # SERVING programs must not grow (params are traced args; the swap's
+    # per-leaf-shape transfer helpers warm here like any host glue)
+    assert eng.hot_swaps == 2, "both staged swaps must have flipped"
+    assert jit_cache_size(_serve_decode_chunk) == d0, (
+        "a same-shape hot-swap recompiled the decode program"
+    )
+    assert jit_cache_size(_serve_prefill_chunk) == p0, (
+        "a same-shape hot-swap recompiled a prefill bucket"
+    )
+    with CompileCounter() as cc:
+        mix(True, seed=1)  # full replay, swap + ticks included
+    assert cc.count == 0, f"hot-swap/ops ticks compiled {cc.count} program(s)"
+
+
+def test_resize_compiles_bounded_then_zero_on_replay(params):
+    """Satellite pin (model-ops PR): a live pool resize may compile only
+    the migration's pow2-bucketed gather/scatter programs and the
+    destination geometry's fresh-pool fills — a constant, not a function
+    of the resident count — and an identical resize schedule replayed on
+    a fresh engine compiles NOTHING at all (both geometries, the
+    migration, and the post-resize serving all replay from cache).
+    Geometries 57 -> 71 are this pin's own (program-shape keys)."""
+
+    def mix(seed, counter=None):
+        eng = ServeEngine(
+            CFG, params, max_slots=3, page_size=8, num_pages=57,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32,
+        )
+        rng = np.random.default_rng(seed)
+        uids = {
+            eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+            for n, m in zip((25, 34, 47), (9, 17, 17))
+        }
+        for _ in range(3):
+            eng.step()
+        if counter is not None:
+            with counter:
+                rec = eng.resize(71)
+        else:
+            rec = eng.resize(71)
+        assert rec["pages_migrated"] >= 1
+        assert set(eng.run()) == uids
+        return eng
+
+    resize_cc = CompileCounter()
+    mix(seed=0, counter=resize_cc)  # warm pass; count the resize alone
+    # one gather + one adoption scatter + the new pool's zero-fills per
+    # pool (f32: no scale leaves) — the sink-padded pow2 bucket keeps the
+    # gather/scatter shapes off the resident count, so this is a small
+    # constant, not O(pages)
+    assert 0 < resize_cc.count <= 10, (
+        f"resize compiled {resize_cc.count} programs — the migration must "
+        "stay a bounded set of bucket-shaped gathers/scatters"
+    )
+    with CompileCounter() as cc:
+        mix(seed=1)
+    assert cc.count == 0, f"resize replay compiled {cc.count} program(s)"
+
+
 def test_train_step_compiles_exactly_once():
     cfg = ExperimentConfig(
         rundir="",

@@ -85,6 +85,7 @@ def test_ring_respects_sharding_layout():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_train_step_matches_naive_sp1():
     """One full training step (FSDP x SP mesh, ring attention, T sharded over
     'sp') produces the same loss as the naive-attention sp=1 step on the same

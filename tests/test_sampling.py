@@ -72,6 +72,7 @@ def test_generate_past_block_size(params):
     assert bool((out[:, :30] == prompt).all())
 
 
+@pytest.mark.slow
 def test_generate_overflow_compiles_once(params, monkeypatch):
     """Generation past the cache must not retrace per token OR per call:
     the overflow window is a static (B, S) slice served by the module-level
@@ -109,6 +110,7 @@ def test_prefill_blockwise_arbitrary_length(params):
     np.testing.assert_allclose(np.asarray(logits), np.asarray(full), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_generate_exact_fill_uses_cache(params):
     """Generation that exactly fills the context must stay on the cache path
     (regression: off-by-one guard dropped the last cache slot)."""

@@ -50,6 +50,7 @@ def test_submit_rejects_oversized_requests(params):
         small.submit(np.zeros(30, np.int32), 30)
 
 
+@pytest.mark.slow
 def test_serve_greedy_parity_with_generate(params):
     """The acceptance pin: a continuous-batched greedy run reproduces
     engine.generate token-for-token for every request in a mixed-length

@@ -274,9 +274,17 @@ def _run(params, split_k, *, dtype=jnp.float32, prefix=False, spec=False,
     return [done[u].tokens.tolist() for u in uids]
 
 
-@pytest.mark.parametrize("dtype", [jnp.float32, "int8"], ids=["f32", "int8"])
+# int8 and spec variants carry the tier-1 suite's heaviest compiles; the
+# f32 plain/prefix/tp rows keep split-parity coverage inside the 870 s gate
+# and the marked rows still run in the full (unfiltered) suite.
 @pytest.mark.parametrize(
-    "feature", ["plain", "spec", "prefix", "tp"]
+    "dtype",
+    [jnp.float32, pytest.param("int8", marks=pytest.mark.slow)],
+    ids=["f32", "int8"],
+)
+@pytest.mark.parametrize(
+    "feature",
+    ["plain", pytest.param("spec", marks=pytest.mark.slow), "prefix", "tp"],
 )
 def test_engine_greedy_streams_identical_split_on_off(params, dtype, feature):
     """The acceptance pin: forcing split_k=4 changes WHICH program decodes

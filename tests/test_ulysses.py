@@ -181,6 +181,7 @@ def test_ulysses_kernel_jnp_paths_agree(monkeypatch):
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_j), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_shard_map_fsdp_train_step_matches_gspmd():
     """Ulysses composes with the explicit shard_map ZeRO-3 schedule the same
     way the ring does (parallel/shard_map_fsdp.py): one body, weight gathers

@@ -635,6 +635,168 @@ def _longctx_bench(args) -> int:
     return 0
 
 
+def _ops_bench(args, cfg, params, cache_dtype, trace, total_new) -> int:
+    """--hot-swap mode: zero-downtime model ops ('serve_ops' profile,
+    analysis/bench_contract.py; protocol: docs/ROBUSTNESS.md 'Zero-downtime
+    model ops').
+
+    One trickle-arrival pass through a live engine with two ops landing
+    mid-trace: a blue/green weight swap from a sha256-verified checkpoint
+    (staged at --swap-round, flipped at the drain boundary), then a live
+    pool grow three rounds after the flip, while the new-weights side is
+    still decoding. Two upfront reference passes (old weights / new
+    weights, same trace, same geometry) provide the bit-exact parity
+    oracles — greedy streams are batch-composition independent, the same
+    property the preemption and disagg gates lean on (schema + parity
+    split enforced in tests/test_bench_contract.py::
+    test_bench_serve_ops_emits_conformant_json_line) — and double as the
+    compile warm-up, so the swap window's jit-cache delta is the headline
+    swap_recompiles == 0 claim: a same-shape swap device_puts onto the
+    live shardings and must reuse every compiled program. The resize leg
+    compiles its gather/adopt programs AFTER the window closes, which is
+    why it runs second."""
+    import tempfile
+    import types
+
+    import jax
+    import numpy as np
+
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.sampling import ops as mops
+    from midgpt_tpu.sampling.engine import restore_for_sampling
+    from midgpt_tpu.sampling.serve import ServeEngine
+    from midgpt_tpu.training.checkpoint import CheckpointManager
+
+    num_pages, grow_pages = 21, 23  # fresh geometries (program-key dims)
+
+    ckpt_dir = os.path.join(
+        tempfile.mkdtemp(prefix="midgpt_ops_bench_"), "ckpt"
+    )
+    mgr = CheckpointManager(ckpt_dir, save_interval_steps=1)
+    mgr.save(
+        7, {"params": GPT.init(cfg, jax.random.PRNGKey(args.seed + 101))},
+        force=True,
+    )
+    mgr.wait()
+    version = mgr.weights_version(7)
+    mgr.close()
+    shim = types.SimpleNamespace(
+        model_config=cfg, fsdp_min_size=1 << 60, param_dtype="float32"
+    )
+    params_new, ckpt_step = restore_for_sampling(ckpt_dir, shim)
+
+    def engine(p):
+        return ServeEngine(
+            cfg, p, max_slots=args.max_slots, page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk, decode_chunk=args.decode_chunk,
+            temperature=0.0, cache_dtype=cache_dtype, num_pages=num_pages,
+        )
+
+    def reference(p):
+        eng = engine(p)
+        uids = [eng.submit(pr, m) for pr, m in trace]
+        done = eng.run()
+        return {u: np.asarray(done[u].tokens) for u in uids}
+
+    def jit_total():
+        return sum(v or 0 for v in ServeEngine.compile_stats().values())
+
+    ref_old = reference(params)  # warms every shape at this geometry
+    ref_new = reference(params_new)
+
+    def drive():
+        """One trickle pass with the swap staged at --swap-round and the
+        pool grow landing three rounds after the flip. Run twice: the
+        first pass warms every shape the ops schedule touches (incl. the
+        resize's gather/adopt programs), so the second pass's jit-cache
+        delta over [stage .. 3 post-flip rounds] isolates what the SWAP
+        ITSELF compiles — the warm-then-count discipline the recompile
+        pins use (tests/test_recompile_pins.py)."""
+        eng = engine(params)
+        pending = list(trace)
+        jit0 = swap_recompiles = None
+        post_flip = r = 0
+        t0 = time.perf_counter()
+        while pending or not eng.idle:
+            if pending and r % 2 == 0:
+                p, m = pending.pop(0)
+                eng.submit(p, m)
+            if r == args.swap_round:
+                jit0 = jit_total()
+                eng.hot_swap(params_new, version=version, config=cfg)
+            eng.step()
+            if eng.hot_swaps and swap_recompiles is None:
+                post_flip += 1
+                if post_flip == 3:  # 3 new-weights decode rounds in window
+                    swap_recompiles = jit_total() - jit0
+                    eng.resize(grow_pages)
+            r += 1
+            assert r < 10_000, "ops bench failed to drain"
+        return eng, swap_recompiles, time.perf_counter() - t0
+
+    drive()  # warm the trickle schedule's shapes end to end
+    eng, swap_recompiles, dt = drive()
+    done = eng.finished
+
+    swap = eng.swap_history[0]
+    rz = eng.resize_history[-1]
+    old_uids = set(swap["served_uids_at_flip"])
+    po = sum(
+        1 for u in old_uids
+        if np.array_equal(np.asarray(done[u].tokens), ref_old[u])
+    )
+    pn = sum(
+        1 for u in done if u not in old_uids
+        and np.array_equal(np.asarray(done[u].tokens), ref_new[u])
+    )
+    try:
+        mops.assert_conserved(eng, "ops bench drain")
+        conserved = True
+    except AssertionError:
+        conserved = False
+
+    print(
+        json.dumps(
+            {
+                "bench": "serve_ops",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "max_slots": args.max_slots,
+                "page_size": args.page_size,
+                "kv_dtype": args.kv_dtype,
+                "num_pages": num_pages,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "checkpoint_step": ckpt_step,
+                "weights_version_before": "inline",
+                "weights_version_after": eng.weights_version,
+                "swap_latency_ms": round(swap["swap_latency_s"] * 1e3, 3),
+                "streams_in_flight_at_flip": len(swap["in_flight_at_stage"]),
+                "staged_round": swap["staged_round"],
+                "flip_round": swap["flip_round"],
+                "dropped": sum(
+                    1 for fr in done.values() if fr.status != "ok"
+                ),
+                "parity_old_side": po,
+                "parity_new_side": pn,
+                "swap_recompiles": swap_recompiles,
+                "resize_from_pages": rz["from_pages"],
+                "resize_to_pages": rz["to_pages"],
+                "pages_migrated": rz["pages_migrated"],
+                "pages_conserved": conserved,
+                "fault_pass_tok_s": round(total_new / dt, 2),
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-requests", type=int, default=12)
@@ -719,6 +881,18 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=6,
                     help="--long-ctx: timed decode rounds per variant "
                     "(median reported; one extra warm round rides first)")
+    ap.add_argument("--hot-swap", action="store_true",
+                    help="zero-downtime model-ops bench: a verified-"
+                    "checkpoint blue/green weight swap lands mid-trace "
+                    "(staged at --swap-round, flipped at the drain "
+                    "boundary) followed by a live pool grow, with bit-"
+                    "exact parity vs old-/new-weights references, zero "
+                    "dropped streams, and a swap-window jit-cache delta "
+                    "required to be 0. Emits the 'serve_ops' JSON profile "
+                    "(docs/ROBUSTNESS.md 'Zero-downtime model ops')")
+    ap.add_argument("--swap-round", type=int, default=5,
+                    help="--hot-swap: engine round at which the candidate "
+                    "weights are staged")
     ap.add_argument("--trace-out", type=str, default=None,
                     help="plain serve profile: directory to dump the timed "
                     "continuous run's flight recorder as a Chrome-trace "
@@ -787,6 +961,9 @@ def main() -> int:
         m = int(rng.integers(8, max(9, min(64, S - t0))))
         trace.append((rng.integers(0, cfg.vocab_size, t0, dtype=np.int64), m))
     total_new = sum(m for _, m in trace)
+
+    if args.hot_swap:
+        return _ops_bench(args, cfg, params, cache_dtype, trace, total_new)
 
     if args.tp:
         return _tp_bench(args, cfg, params, trace, total_new)

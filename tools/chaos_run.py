@@ -17,6 +17,16 @@ run — robustness/chaos_serve.py) and reports shed/timeout counts:
     python tools/chaos_run.py --serve --fault kill_mid_decode@6
     python tools/chaos_run.py --serve --fault poisoned_page@8 --fault slow_client@1
 
+Zero-downtime model-ops gates (docs/ROBUSTNESS.md): a verified-checkpoint
+blue/green weight swap mid-trace, and a live grow-then-shrink pool resize
+on an int8 cache, both with bit-exact greedy parity and zero drops:
+
+    python tools/chaos_run.py --serve --fault hot_swap_mid_decode@5
+    python tools/chaos_run.py --serve --fault pool_resize@4 --fault pool_resize@8
+
+`--list-faults` prints the registered kinds with one-line descriptions;
+unknown `--fault` kinds fail up front with that same list.
+
 With `--rundir`, serving mode records the fault pass under a flight
 recorder and leaves `flight_recorder.json` (Chrome trace — open in
 Perfetto or summarize with tools/trace_view.py) plus `.prom` metrics
@@ -52,6 +62,37 @@ def _load_launch():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _list_faults() -> int:
+    """--list-faults: the registered fault kinds with their one-line
+    descriptions (robustness/faults.py DESCRIPTIONS) — the discoverable
+    index of the registry, so operators don't read the module to learn
+    what `--fault` accepts."""
+    from midgpt_tpu.robustness import faults
+
+    width = max(len(k) for k in faults.KINDS)
+    for kind in faults.KINDS:
+        print(f"  {kind:<{width}}  {faults.DESCRIPTIONS[kind]}")
+    return 0
+
+
+def _validate_fault_specs(parser, specs) -> None:
+    """Fail unknown --fault kinds up front with the described kind list
+    instead of a deep ValueError (or nothing happening at all)."""
+    from midgpt_tpu.robustness import faults
+
+    for spec in specs:
+        m = faults._PLAN_RE.match(spec.strip())
+        kind = m.group("kind") if m else spec
+        if m is None or kind not in faults.KINDS:
+            lines = "\n".join(
+                f"  {k}: {faults.DESCRIPTIONS[k]}" for k in faults.KINDS
+            )
+            parser.error(
+                f"unknown fault spec {spec!r} (want KIND[@STEP][*TIMES]). "
+                f"Registered kinds:\n{lines}"
+            )
 
 
 def _serve_main(args) -> int:
@@ -112,7 +153,16 @@ def main() -> int:
                         help="--serve: trace/model seed")
     parser.add_argument("--n-requests", type=int, default=5,
                         help="--serve: requests in the seeded trace")
+    parser.add_argument(
+        "--list-faults", action="store_true",
+        help="print the registered fault kinds with one-line descriptions "
+        "and exit (robustness/faults.py)",
+    )
     args = parser.parse_args()
+
+    if args.list_faults:
+        return _list_faults()
+    _validate_fault_specs(parser, args.fault)
 
     import jax
 

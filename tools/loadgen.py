@@ -273,6 +273,15 @@ def main() -> int:
                     help="directory to dump one Chrome-trace flight "
                     "recorder (+ .prom metrics) per offered-load point — "
                     "open in Perfetto or roll up with tools/trace_view.py")
+    ap.add_argument("--hot-swap", action="store_true",
+                    help="zero-downtime ops under load: at each point, a "
+                    "verified-checkpoint blue/green weight swap is staged "
+                    "through the async front door mid-arrival-window "
+                    "(sampling/ops.py; docs/ROBUSTNESS.md 'Zero-downtime "
+                    "model ops'). Points and headline carry the "
+                    "weights_version transition; the SLO acceptance is the "
+                    "curve staying inside the error budget THROUGH the "
+                    "swap — same slo_ok computation, no special-casing")
     # engine/model shape (tiny defaults: the CPU-mesh scheduling testbed)
     ap.add_argument("--max-slots", type=int, default=3)
     ap.add_argument("--page-size", type=int, default=8)
@@ -388,6 +397,39 @@ def main() -> int:
     warm = make_engine()
     _warm_compile_grid(warm, cfg, args.decode_chunk, args.page_size, args.seed)
 
+    # --hot-swap: one verified checkpoint (training/checkpoint.py sha256
+    # manifest) restored once; every point stages the same candidate, so
+    # points stay comparable. Same shapes as the live params — the swap
+    # must not compile anything (tests/test_recompile_pins.py pins it).
+    swap_payload = None
+    if args.hot_swap:
+        import tempfile
+        import types
+
+        from midgpt_tpu.sampling.engine import restore_for_sampling
+        from midgpt_tpu.training.checkpoint import CheckpointManager
+
+        ckpt_dir = os.path.join(
+            tempfile.mkdtemp(prefix="midgpt_loadgen_swap_"), "ckpt"
+        )
+        mgr = CheckpointManager(ckpt_dir, save_interval_steps=1)
+        mgr.save(
+            3, {"params": GPT.init(cfg, jax.random.PRNGKey(args.seed + 101))},
+            force=True,
+        )
+        mgr.wait()
+        swap_version = mgr.weights_version(3)
+        mgr.close()
+        shim = types.SimpleNamespace(
+            model_config=cfg, fsdp_min_size=1 << 60, param_dtype="float32"
+        )
+        # Replicated restore (mesh=None — restore_for_sampling's mesh arg
+        # wants a training fsdp mesh, not a serve mesh): stage_hot_swap
+        # device_puts the candidate onto the LIVE params' shardings, which
+        # re-shards it correctly for tp engines too.
+        swap_params, _ = restore_for_sampling(ckpt_dir, shim)
+        swap_payload = (swap_params, swap_version)
+
     # Shared prompt heads for the template mixture: ~3 pages each, built
     # once per seed (see _mixture on why once-per-seed matters).
     template_rng = np.random.default_rng(args.seed + 31)
@@ -416,9 +458,24 @@ def main() -> int:
 
         async def run_point():
             driver = asyncio.create_task(server.run())
+            swapper = None
+            if swap_payload is not None:
+                # Stage mid-arrival-window (the median arrival): traffic
+                # lands on both sides of the flip, so the point's
+                # percentiles measure the swap's SLO cost, not a quiet
+                # engine's.
+                async def do_swap():
+                    await asyncio.sleep(arrivals[len(arrivals) // 2])
+                    await server.hot_swap(
+                        swap_payload[0], version=swap_payload[1], config=cfg
+                    )
+
+                swapper = asyncio.create_task(do_swap())
             records = await _drive_point(
                 server, reqs, arrivals, args.ttl_s or None
             )
+            if swapper is not None:
+                await swapper
             await server.drain()
             await driver
             return records
@@ -428,6 +485,14 @@ def main() -> int:
             rate, records, args.error_budget,
             args.slo_ttft_ms, args.slo_tpot_ms,
         )
+        if swap_payload is not None:
+            # The transition a metrics scrape would see on this point.
+            stats["weights_version"] = engine.weights_version
+            stats["hot_swaps"] = engine.hot_swaps
+            stats["swap_flip_round"] = (
+                engine.swap_history[-1]["flip_round"]
+                if engine.swap_history else None
+            )
         if args.prefix_cache:
             # Engine-side observability through the front door's stats()
             # passthrough — what a deployment's metrics scrape would read.
@@ -500,6 +565,17 @@ def main() -> int:
                 "round_host_ms": worst["round_host_ms"],
                 "round_device_ms": worst["round_device_ms"],
                 "prefix_hit_rate": worst.get("prefix_hit_rate"),
+                # --hot-swap: the version transition every point rode
+                # (docs/ROBUSTNESS.md 'Zero-downtime model ops'); slo_ok
+                # below is then the "curve stays flat through the swap"
+                # acceptance, with no special-casing.
+                "weights_versions": (
+                    ["inline", swap_payload[1]] if swap_payload else None
+                ),
+                "hot_swaps": (
+                    sum(p.get("hot_swaps", 0) for p in points)
+                    if swap_payload else None
+                ),
                 "slo_ok": bool(all(p["slo_ok"] for p in points)),
             }
         )
