@@ -505,6 +505,100 @@ def check_serve_ops_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_fleet_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --fleet profile: the shared-template trace
+    through one engine, then through an N-replica FleetRouter with a
+    replica killed mid-trace (docs/ROBUSTNESS.md 'Fleet serving &
+    failover'). The record carries the fleet's availability claim, so its
+    gates are structural:
+
+      * failovers >= 1 and dropped == 0 — a replica actually died and the
+        fleet still finished every accepted stream (otherwise the record
+        measured an unfaulted fleet and claims nothing).
+      * greedy_match_frac == 1.0 EXACTLY with parity_checked ==
+        n_requests — every stream, survivors and failover replays alike,
+        bit-matches the single-engine pass; failover replays the original
+        prompt with the full budget and greedy streams are
+        batch-composition-independent, so any mismatch is a router bug
+        (or a spill page that poisoned a decode), not noise.
+      * fleet_hit_rate >= single_hit_rate — prefix-affinity routing
+        exists so the fleet trie hit rate does NOT dilute toward 1/N of
+        the single engine's; a lower rate means the rendezvous hash
+        stopped steering templates to their pages.
+      * pages_conserved — per-alive-replica pool law plus the spill
+        ledger closed after the drain."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "fleet_size": (int,),
+            "model": (dict,),
+            "kv_dtype": (str,),
+            "num_pages": (int,),
+            "n_templates": (int,),
+            "single_tok_s": Number,
+            "fleet_tok_s": Number,
+            "single_hit_rate": Number,
+            "fleet_hit_rate": Number,
+            "failovers": (int,),
+            "failed_over_streams": (int,),
+            "dropped": (int,),
+            "parity_checked": (int,),
+            "greedy_match_frac": Number,
+            "spill_readopted_pages": (int,),
+            "spill": (dict,),
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_fleet":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_fleet'"
+        )
+    fs = rec.get("fleet_size")
+    if isinstance(fs, int) and fs < 2:
+        problems.append(
+            f"fleet_size {fs} < 2 — a one-replica fleet cannot fail over"
+        )
+    if rec.get("failovers") == 0:
+        problems.append(
+            "failovers == 0 — no replica died, the availability A/B is vacuous"
+        )
+    if rec.get("dropped") != 0:
+        problems.append(
+            f"dropped {rec.get('dropped')!r} != 0 — failover must finish "
+            "every accepted stream"
+        )
+    gmf = rec.get("greedy_match_frac")
+    if isinstance(gmf, Number) and gmf != 1.0:
+        problems.append(
+            f"greedy_match_frac {gmf} != 1.0 — failover replays and spill "
+            "re-adoption must be bit-invisible to greedy streams"
+        )
+    pc, nr = rec.get("parity_checked"), rec.get("n_requests")
+    if isinstance(pc, int) and isinstance(nr, int) and pc != nr:
+        problems.append(
+            f"parity_checked {pc} != n_requests {nr} — some stream was "
+            "never checked against the single-engine reference"
+        )
+    fh, sh = rec.get("fleet_hit_rate"), rec.get("single_hit_rate")
+    for name, v in (("fleet_hit_rate", fh), ("single_hit_rate", sh)):
+        if isinstance(v, Number) and not 0.0 <= v <= 1.0:
+            problems.append(f"{name} {v} outside [0, 1]")
+    if isinstance(fh, Number) and isinstance(sh, Number) and fh < sh:
+        problems.append(
+            f"fleet_hit_rate {fh} < single_hit_rate {sh} — affinity "
+            "routing failed to protect the trie hit rate"
+        )
+    if "pages_conserved" not in rec or rec["pages_conserved"] is not True:
+        problems.append("field 'pages_conserved' must be literal true")
+    return problems
+
+
 def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     """tools/loadgen.py profile: TTFT/TPOT percentiles + shed fraction
     under a seeded arrival process, at >= 2 offered-load points (one point
@@ -582,6 +676,24 @@ def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     sf = rec.get("shed_frac")
     if isinstance(sf, Number) and not 0.0 <= sf <= 1.0:
         problems.append(f"shed_frac {sf} outside [0, 1]")
+    # optional fleet block: present when loadgen ran with --fleet N
+    # (headline mirrors the hottest point, like the SLO percentiles)
+    fs = rec.get("fleet_size")
+    if fs is not None:
+        if not isinstance(fs, int) or fs < 1:
+            problems.append(f"fleet_size {fs!r} must be an int >= 1")
+        for key in ("failovers", "spill_hits"):
+            v = rec.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(
+                    f"fleet record field {key!r} must be an int >= 0, "
+                    f"got {v!r}"
+                )
+        hr = rec.get("prefix_hit_rate")
+        if not isinstance(hr, Number) or not 0.0 <= hr <= 1.0:
+            problems.append(
+                f"fleet record 'prefix_hit_rate' {hr!r} outside [0, 1]"
+            )
     return problems
 
 
@@ -622,6 +734,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "serve_tp": check_serve_tp_bench,
     "serve_longctx": check_serve_longctx_bench,
     "serve_ops": check_serve_ops_bench,
+    "serve_fleet": check_serve_fleet_bench,
     "serve_slo": check_serve_slo_bench,
     "graftcheck": check_graftcheck,
 }

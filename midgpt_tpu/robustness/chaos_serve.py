@@ -36,6 +36,16 @@ check instead of invariant 3's two-sided one:
     (asserted inside resize_pool AND after the drain) and EVERY stream is
     bit-identical to a no-resize pass — a resize affects nobody.
 
+The fleet scenarios (sampling/fleet.py, `_run_fleet_chaos`) run the trace
+through TWO replicas behind a FleetRouter with its shared host-RAM spill
+tier and extend all three invariants across replicas and tiers:
+`engine_crash@k` kills the busiest replica at router round k — zero
+accepted streams drop, failover replays bit-match the single-engine
+reference; `handoff_stall` / `spill_corrupt` hit the spill path — a
+stalled transport falls back to re-prefill and a corrupt page is caught
+by the take-side checksum, either way never a token mismatch — with
+cross-tier page conservation (assert_fleet_conserved) after the drain.
+
 Faults are deterministic for a seeded trace: round-keyed kinds fire on the
 engine's round counter (`kill_mid_decode@7` = round 7), slow_client keys on
 the victim uid, submit_storm keys on the arrival index at which the burst
@@ -304,6 +314,13 @@ def run_serving_chaos(
     with `trace_dir` the Chrome trace + .prom metrics land there
     unconditionally; without one they land in a temp dir only when an
     invariant fails (the path rides the AssertionError)."""
+    if any(
+        k in fault_plan
+        for k in ("engine_crash", "handoff_stall", "spill_corrupt")
+    ):
+        return _run_fleet_chaos(
+            fault_plan, seed=seed, n_requests=n_requests, trace_dir=trace_dir
+        )
     if "hot_swap_mid_decode" in fault_plan:
         return _run_hot_swap_chaos(
             fault_plan, seed=seed, n_requests=n_requests, trace_dir=trace_dir
@@ -388,6 +405,172 @@ def run_serving_chaos(
             "prefix_cache": eng.prefix_cache is not None,
             "prefix_reclaimed": eng.prefix_evictions,
             "prefix_hit_rate": eng.prefix_stats()["hit_rate"],
+        }
+
+    return _run_scenario(obs, trace_dir, body)
+
+
+# -- fleet scenarios (sampling/fleet.py) -----------------------------------
+
+
+def _fleet_router(cfg, params, obs, n_replicas: int = 2):
+    """The fleet-under-fault: `n_replicas` prefix-cached greedy engines
+    behind a FleetRouter with its shared spill tier (the router attaches
+    it). Same per-replica shape as _engine except the pool: 31 is a fresh
+    program-key geometry — not 25 (recompile-pin baseline), 27 (loadgen),
+    29 (single-engine chaos), or 43/37 (resize targets)."""
+    import jax.numpy as jnp
+
+    from midgpt_tpu.sampling.fleet import FleetRouter
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    engines = [
+        ServeEngine(
+            cfg,
+            params,
+            max_slots=3,
+            page_size=8,
+            num_pages=31,
+            prefill_chunk=16,
+            decode_chunk=4,
+            temperature=0.0,
+            cache_dtype=jnp.float32,
+            prefix_cache=True,
+            obs=obs,
+            obs_tid=f"replica{i}",
+        )
+        for i in range(n_replicas)
+    ]
+    return FleetRouter(engines)
+
+
+def _run_fleet_chaos(fault_plan, *, seed, n_requests, trace_dir):
+    """Fleet degradation gate (docs/ROBUSTNESS.md "Fleet serving &
+    failover"): run the shared-template trace through a 2-replica fleet
+    with `fault_plan` armed and assert the three invariants extended
+    across replicas and tiers —
+
+      1. Alive: the FLEET finishes the trace; killing a replica mid-trace
+         (engine_crash) drops ZERO accepted streams — they fail over.
+      2. Conserved, cross-tier: every alive replica obeys the pool law
+         and the spill ledger closes (assert_fleet_conserved), including
+         through the spill_corrupt discard path.
+      3. Bit-identical: EVERY stream — survivors and failover replays —
+         matches a fault-free single-engine reference pass. A corrupt or
+         stalled spill page may cost a re-prefill, never a token.
+
+    The spill-path kinds (handoff_stall / spill_corrupt) need resident
+    spilled pages to bite on, which organic pressure only produces at
+    pool sizes that make the trace nondeterministically tight. Instead
+    the scenario STAGES the tier: the first request runs alone, then
+    every replica's trie is force-flushed (the same reclaim the
+    evict_shared_prefix fault models), spilling its pages to the host
+    tier deterministically; the remaining same-template requests then
+    consult the tier on admission — where the armed stall refuses the
+    first useful run and the armed corruption is caught by the take-side
+    checksum."""
+    from midgpt_tpu.sampling.fleet import assert_fleet_conserved
+
+    cfg, params = _tiny_model(seed)
+    trace = _trace(cfg, seed + 1, n_requests, shared=True)
+    ref_tokens = _reference_pass(cfg, params, trace, prefix=True)
+
+    faults.clear()
+    armed = faults.activate_plan(fault_plan)
+    obs = Observability()
+    router = _fleet_router(cfg, params, obs)
+    stage_spill = any(
+        k in fault_plan for k in ("handoff_stall", "spill_corrupt")
+    )
+
+    def body() -> tp.Dict[str, tp.Any]:
+        uid_to_idx: tp.Dict[int, int] = {}
+        pending = list(enumerate(trace))
+        if stage_spill and pending:
+            idx, (prompt, m) = pending.pop(0)
+            uid_to_idx[router.submit(prompt, m)] = idx
+            router.run()
+            for i, rep in enumerate(router.engines):
+                if router.alive[i]:
+                    rep._evict_shared_prefix_fault()
+        r = 0
+        while pending or not router.idle:
+            if pending:
+                idx, (prompt, m) = pending.pop(0)
+                # trickled one per round (like _run_trickle): a mid-trace
+                # crash deterministically finds accepted streams in flight
+                uid_to_idx[router.submit_retry(prompt, m)] = idx
+            router.step()
+            r += 1
+            assert r < 10_000, "fleet drive did not converge"
+        fired = faults.fired_counts()
+        faults.clear()
+
+        # -- invariant 2, extended across replicas AND tiers -------------
+        assert_fleet_conserved(router, "after drain")
+        for i, rep in enumerate(router.engines):
+            if router.alive[i]:
+                _assert_drained_conserved(rep)
+
+        # -- invariants 1 + 3: zero drops, every stream bit-identical ----
+        statuses: tp.Dict[str, int] = {}
+        parity_checked = parity_ok = 0
+        for uid, idx in uid_to_idx.items():
+            fr = router.finished.get(uid)
+            assert fr is not None, f"accepted stream {uid} vanished"
+            statuses[fr.status] = statuses.get(fr.status, 0) + 1
+            assert fr.status == "ok", (
+                f"accepted stream {uid} dropped with status {fr.status!r}"
+            )
+            parity_checked += 1
+            if np.array_equal(np.asarray(fr.tokens), ref_tokens[idx]):
+                parity_ok += 1
+        assert parity_ok == parity_checked, (
+            f"greedy parity broke on {parity_checked - parity_ok} "
+            f"stream(s) vs the fault-free single-engine pass"
+        )
+        assert sum(fired.values()) >= min(1, len(armed)), "no armed fault fired"
+        if fired.get("engine_crash"):
+            assert router.failovers >= 1, "crash fired but nobody died"
+            assert router.failed_over_streams >= 1, (
+                "crash fired with no accepted streams to fail over — "
+                "the gate proved nothing"
+            )
+        if fired.get("handoff_stall"):
+            assert router.spill.stall_fallbacks >= 1, (
+                "stall armed but no consult ever fell back to re-prefill"
+            )
+        if fired.get("spill_corrupt"):
+            assert router.spill.corrupt_discarded >= 1, (
+                "corruption armed but never caught by the take-side checksum"
+            )
+
+        return {
+            "mode": "serve",
+            "fault_plan": fault_plan,
+            "faults_fired": fired,
+            "n_requests": n_requests,
+            "statuses": statuses,
+            "shed": sum(e.shed for e in router.engines),
+            "timeouts": sum(e.timeouts for e in router.engines),
+            "cancelled": sum(e.cancelled for e in router.engines),
+            "decode_kills": sum(e.decode_kills for e in router.engines),
+            "preemptions": sum(e.preemptions for e in router.engines),
+            "poisoned": 0,
+            "parity_checked": parity_checked,
+            "parity_ok": parity_ok,
+            "pages_conserved": True,
+            "prefix_cache": True,
+            "prefix_reclaimed": sum(
+                e.prefix_evictions for e in router.engines
+            ),
+            "prefix_hit_rate": router.prefix_hit_rate(),
+            "fleet_size": len(router.engines),
+            "alive": sum(router.alive),
+            "failovers": router.failovers,
+            "failed_over_streams": router.failed_over_streams,
+            "dropped_streams": 0,
+            "spill": router.spill.stats(),
         }
 
     return _run_scenario(obs, trace_dir, body)

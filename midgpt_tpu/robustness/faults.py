@@ -70,6 +70,27 @@ firing deterministic):
                      holds at every boundary, and live streams stay
                      greedy-bit-exact vs a no-resize run.
 
+Fleet kinds (hooked in sampling/fleet.py `FleetRouter.step`, keyed on the
+ROUTER round counter; scenarios in robustness/chaos_serve.py):
+
+  engine_crash       kill the alive replica holding the most accepted
+                     streams mid-trace: its finished results are
+                     harvested, every accepted-but-unfinished stream
+                     fails over to survivors through the bounded handoff
+                     queue, and the replays must come out greedy
+                     bit-identical to a fault-free pass — zero dropped
+                     accepted streams, cross-tier conservation intact.
+  handoff_stall      wedge the host page transport: the spill tier's next
+                     consult that WOULD return pages refuses instead
+                     (stays armed until one would), and the admission
+                     falls back to plain re-prefill — slower, never
+                     wrong, streams bit-identical.
+  spill_corrupt      flip a byte in the most recently spilled host-RAM
+                     page without updating its checksum (stays armed
+                     until something is resident): the take-side crc32
+                     verification must discard it and re-prefill — a
+                     corrupt spill page never yields a token mismatch.
+
 Activation: programmatic (`activate(...)`), or a plan string from config
 (`ExperimentConfig.fault_plan`) / the MIDGPT_FAULTS env var, parsed by
 `activate_plan`: comma-separated `kind[@step][*times]`, e.g.
@@ -98,6 +119,10 @@ KINDS = (
     "evict_shared_prefix",
     "hot_swap_mid_decode",
     "pool_resize",
+    # fleet (sampling/fleet.py FleetRouter.step, chaos_serve.py)
+    "engine_crash",
+    "handoff_stall",
+    "spill_corrupt",
 )
 
 # One-line summaries for operator tooling (`tools/chaos_run.py --serve
@@ -116,6 +141,9 @@ DESCRIPTIONS: tp.Dict[str, str] = {
     "evict_shared_prefix": "force-flush every unreferenced prefix-trie page at once",
     "hot_swap_mid_decode": "blue/green weight swap mid-trace (engine swap_source)",
     "pool_resize": "live KV pool resize to the engine's next resize_plan target",
+    "engine_crash": "kill the busiest fleet replica; streams fail over to survivors",
+    "handoff_stall": "wedge the spill-tier transport; admissions re-prefill instead",
+    "spill_corrupt": "bit-flip a spilled host-RAM KV page; checksum must catch it",
 }
 
 _PLAN_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$")

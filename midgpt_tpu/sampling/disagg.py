@@ -47,6 +47,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import itertools
 import time
 import typing as tp
 
@@ -56,6 +57,7 @@ import numpy as np
 
 from midgpt_tpu.models.gpt import GPTConfig, GPTParams, PagedKVCache
 from midgpt_tpu.obs import DISABLED_SNAPSHOT, Observability
+from midgpt_tpu.robustness.backoff import backoff_delays
 from midgpt_tpu.obs.trace import NULL_TRACER
 from midgpt_tpu.sampling.serve import (
     BackpressureError,
@@ -107,38 +109,108 @@ class HandoffItem:
     n_pages: int
 
 
-class PageHandoffQueue:
-    """FIFO of HandoffItems with transfer accounting. Host-side and
-    process-local here (both roles live in one process on the test mesh);
-    the counters are the interface a cross-host transport would have to
-    honor — bytes_copied is the KV traffic the disaggregation actually
-    moves, the number to weigh against the prompt re-prefill FLOPs it
-    saves."""
+class HandoffRetryExhausted(RuntimeError):
+    """A queued page-transport item was refused by its destination more
+    times than the queue's bounded retry budget allows. Structured like
+    BackpressureError: `uid` identifies the stream, `attempts` the spent
+    budget, so a router can convert it into a terminal shed instead of
+    retrying forever (graceful degradation, never a silent drop)."""
 
-    def __init__(self):
-        self._q: tp.Deque[HandoffItem] = collections.deque()
+    def __init__(self, message: str, *, uid: int, attempts: int):
+        super().__init__(message)
+        self.uid = uid
+        self.attempts = attempts
+
+
+class PageHandoffQueue:
+    """FIFO of page-transport items with transfer accounting and a bounded
+    retry-with-backoff schedule — the general page-transport primitive:
+    disagg's prefill->decode handoff and the fleet router's failover
+    resubmission (sampling/fleet.py) both ride it. Host-side and
+    process-local here (all roles live in one process on the test mesh);
+    the counters are the interface a cross-host transport would have to
+    honor — bytes_copied is the KV traffic the transport actually moves,
+    the number to weigh against the prompt re-prefill FLOPs it saves.
+
+    Items are duck-typed: anything with `uid`, `n_pages`, and `blocks`
+    queues (HandoffItem, fleet.FailoverItem). Retry state lives ON the
+    item (`_handoff_attempts`, `_not_before`), so requeue backs an item
+    off on the SAME exponential schedule every transient-failure path in
+    the repo uses (robustness/backoff.py: base_s * 2**attempt), and a
+    destination that keeps refusing raises the structured
+    HandoffRetryExhausted instead of spinning — ad-hoc unbounded
+    front-requeue loops are gone."""
+
+    def __init__(
+        self,
+        *,
+        retries: int = 32,
+        base_s: float = 0.0,
+        clock: tp.Callable[[], float] = time.perf_counter,
+    ):
+        if retries < 1:
+            raise ValueError(f"retries must be >= 1, got {retries}")
+        self._q: tp.Deque[tp.Any] = collections.deque()
+        self.retries = retries
+        self.base_s = base_s
+        self._clock = clock
         self.enqueued = 0
         self.dequeued = 0
         self.pages_copied = 0
         self.bytes_copied = 0
+        self.retry_exhausted = 0
 
     def __len__(self) -> int:
         return len(self._q)
 
-    def push(self, item: HandoffItem) -> None:
+    def push(self, item) -> None:
         self.enqueued += 1
         self.pages_copied += item.n_pages
         self.bytes_copied += sum(b.nbytes for b in item.blocks.values())
+        item._handoff_attempts = 0
+        item._not_before = 0.0
         self._q.append(item)
 
-    def pop(self) -> HandoffItem:
+    def pop(self, now: tp.Optional[float] = None):
+        """The next ready item, or None when the queue is empty or its head
+        is still inside a backoff window (FIFO order is preserved — a
+        backed-off head shields the items behind it, which would only be
+        refused by the same full destination)."""
+        if not self._q:
+            return None
+        item = self._q[0]
+        if getattr(item, "_not_before", 0.0) > (
+            self._clock() if now is None else now
+        ):
+            return None
         self.dequeued += 1
         return self._q.popleft()
 
-    def requeue(self, item: HandoffItem) -> None:
-        """Return a popped item to the FRONT (decode admission refused it;
-        it keeps its place)."""
+    def requeue(self, item) -> None:
+        """Return a refused item to the FRONT (it keeps its place) with the
+        next exponential delay stamped on it. Raises HandoffRetryExhausted
+        once the item has been refused `retries` times — the caller owns
+        the terminal disposition (disagg: fallback re-prefill happened
+        earlier; fleet: terminal shed)."""
         self.dequeued -= 1
+        attempts = getattr(item, "_handoff_attempts", 0) + 1
+        item._handoff_attempts = attempts
+        if attempts >= self.retries:
+            self.retry_exhausted += 1
+            raise HandoffRetryExhausted(
+                f"handoff uid={item.uid} refused {attempts} times "
+                f"(budget {self.retries})",
+                uid=item.uid,
+                attempts=attempts,
+            )
+        # attempts-th delay of the shared schedule: base_s * 2**(attempts-1)
+        delay = next(
+            itertools.islice(
+                backoff_delays(self.retries, self.base_s), attempts - 1, None
+            ),
+            0.0,
+        )
+        item._not_before = self._clock() + delay
         self._q.appendleft(item)
 
     def stats(self) -> tp.Dict[str, int]:
@@ -148,6 +220,7 @@ class PageHandoffQueue:
             "dequeued": self.dequeued,
             "pages_copied": self.pages_copied,
             "bytes_copied": self.bytes_copied,
+            "retry_exhausted": self.retry_exhausted,
         }
 
 
@@ -214,7 +287,11 @@ class DisaggServe:
             obs=obs, obs_tid="decode",
             **{**engine_kw, **(decode_kw or {})},
         )
-        self.queue = PageHandoffQueue()
+        # Bounded transport: a decode role that refuses the same item 512
+        # ticks in a row is wedged, and the structured exhaustion below
+        # converts the stream to a terminal shed instead of spinning the
+        # pipeline forever (base_s=0: the pipeline tick IS the pacing).
+        self.queue = PageHandoffQueue(retries=512, base_s=0.0, clock=clock)
         self.finished: tp.Dict[int, FinishedRequest] = {}
         # disagg uid -> (prompt, max_new, eos, deadline), keyed twice over
         # the role engines' own uid spaces while a request is inside one.
@@ -394,8 +471,10 @@ class DisaggServe:
         )
 
     def _drain_queue(self) -> None:
-        while len(self.queue):
+        while True:
             item = self.queue.pop()
+            if item is None:
+                break
             if item.deadline is not None:
                 remaining = item.deadline - self._clock()
                 if remaining <= 0:
@@ -417,7 +496,18 @@ class DisaggServe:
                     ttl_s=remaining,
                 )
             except BackpressureError:
-                self.queue.requeue(item)
+                try:
+                    self.queue.requeue(item)
+                except HandoffRetryExhausted:
+                    # wedged decode role: terminal shed, never a spin
+                    self._finish(
+                        FinishedRequest(
+                            item.uid,
+                            np.append(item.prompt, np.int32(item.first_token)),
+                            [item.first_time],
+                            "shed",
+                        )
+                    )
                 break  # decode role is full; retry next tick
             with self._trace.span("handoff.adopt", "disagg", "disagg"):
                 self._adopt(item)

@@ -119,6 +119,16 @@ class PrefixCache:
         self._root = _Node([], None)
         self._tick = 0  # monotonic LRU clock (bumped per trie operation)
         self._n_pages = 0  # entries currently held (refs 0 included)
+        # Spill hook (sampling/fleet.py SpillTier): called per evicted entry
+        # with (full_prefix_tokens, page) BEFORE the page returns to the
+        # allocator, where full_prefix_tokens is the entry's complete token
+        # prefix from the root (the spill tier's lookup key must be
+        # position-dependent — the same page content at a different depth is
+        # different KV). Host-only; the page's device bytes are still intact
+        # when the hook runs because the allocator hasn't reissued the page.
+        self.on_evict: tp.Optional[
+            tp.Callable[[tp.Tuple[int, ...], int], None]
+        ] = None
 
     # -- keys ----------------------------------------------------------
 
@@ -296,12 +306,30 @@ class PrefixCache:
                     best = node
             if best is None:
                 break
+            if self.on_evict is not None:
+                self.on_evict(self._full_prefix(best), best.entries[-1].page)
             e = best.entries.pop()
             freed.append(e.page)
             self._n_pages -= 1
             if not best.entries:
                 self._detach(best)
         return freed
+
+    def _full_prefix(self, node: _Node) -> tp.Tuple[int, ...]:
+        """The complete token prefix of `node`'s LAST entry, reconstructed
+        by walking the parent chain — the position-dependent identity a
+        spill tier must key on (module docstring: a page's KV depends on
+        every token before it, not just the page_size tokens inside it)."""
+        chain: tp.List[_Node] = []
+        n: tp.Optional[_Node] = node
+        while n is not None and n is not self._root:
+            chain.append(n)
+            n = n.parent
+        toks: tp.List[int] = []
+        for anc in reversed(chain):
+            for e in anc.entries:
+                toks.extend(e.key)
+        return tuple(toks)
 
     # -- accounting (tests, chaos conservation, backpressure) ----------
 

@@ -586,6 +586,14 @@ class ServeEngine:
         self.cow_pages = 0
         self.prefix_evictions = 0
         self.prefilled_tokens = 0
+        # Host-RAM KV spill tier (sampling/fleet.py SpillTier), wired by
+        # attach_spill: evicted trie pages land there instead of being
+        # discarded, and _admit re-adopts resident runs past the trie
+        # match. None (default): evictions discard, the pre-fleet
+        # behavior.
+        self.spill_tier = None
+        self.spill_readopted_pages = 0
+        self.spill_readopt_events = 0
         self.cache = PagedKVCache.init(
             config, num_pages=num_pages, page_size=page_size, dtype=cache_dtype
         )
@@ -887,6 +895,105 @@ class ServeEngine:
 
         return _ops.resize_pool(self, num_pages, max_slots=max_slots)
 
+    def attach_spill(self, tier) -> None:
+        """Wire a host-RAM spill tier (sampling/fleet.py SpillTier) under
+        the prefix trie: every refcount-0 eviction — allocator pressure,
+        forced flush, resize overflow, disagg adopt-side reclaim — lands
+        the page's content in `tier` keyed by its full token prefix
+        (PrefixCache.on_evict) instead of discarding it, stamped with the
+        CURRENT weights_version so a hot swap can never resurrect
+        old-weights KV. Requires the prefix cache: the trie is both the
+        spill source and the re-adoption anchor."""
+        if self.prefix_cache is None:
+            raise ValueError("attach_spill requires prefix_cache=True")
+        tier.set_page_size(self.page_size)
+        self.spill_tier = tier
+        self.prefix_cache.on_evict = lambda prefix, page: tier.spill(
+            self.cache, prefix, page, self.weights_version
+        )
+
+    def _readopt_from_spill(self, slot: "_Slot", req: "Request") -> None:
+        """Extend an admission's trie match with spilled pages: consult
+        the tier for a resident run starting exactly where the match
+        stopped, allocate plainly (a spill hit is an optimization, never
+        a demand — it must not evict trie pages or preempt anyone),
+        checksum-verify and move the run out of the tier, scatter it into
+        the pool through the disagg adoption jit (pow2 dst bucket,
+        oob-padded — the one page-transport funnel), and start the slot
+        committed past it. The re-adopted pages are PRIVATE until prefill
+        completion, when insert_live shares them like any other complete
+        prompt pages. A checksum or weights_version mismatch truncates
+        the run inside take_run and those tokens simply re-prefill —
+        corrupt spill bytes can never reach a decode."""
+        tier = self.spill_tier
+        ps = self.page_size
+        start = len(slot.pages)
+        limit = (len(req.prompt) - 1) // ps - start
+        if limit <= 0:
+            return
+        n = tier.peek_run(req.prompt, start, limit, self.weights_version)
+        if n == 0:
+            return
+        n = min(n, self.allocator.free_count)  # plain alloc: take what's free
+        if n == 0:
+            return
+        got = self.allocator.alloc(n)
+        if got is None:
+            return
+        blocks_list = tier.take_run(req.prompt, start, n, self.weights_version)
+        m = len(blocks_list)
+        if m == 0:
+            self.allocator.free(got)
+            return
+        if m < n:
+            self.allocator.free(got[m:])
+            got = got[:m]
+        with self._trace.span("spill.readopt", "prefix", self._obs_tid):
+            blocks = {
+                key: np.stack(
+                    [b[key] for b in blocks_list],
+                    axis=1 if key.endswith("scale") else 2,
+                )
+                for key in blocks_list[0]
+            }
+            bucket = 1
+            while bucket < m:
+                bucket *= 2
+            pad = bucket - m
+            if pad:
+
+                def _zpad(blk: np.ndarray, axis: int) -> np.ndarray:
+                    shape = list(blk.shape)
+                    shape[axis] = pad
+                    return np.concatenate(
+                        [blk, np.zeros(shape, blk.dtype)], axis=axis
+                    )
+
+                blocks = {
+                    k: _zpad(b, 1 if k.endswith("scale") else 2)
+                    for k, b in blocks.items()
+                }
+            dst = jnp.asarray(
+                np.asarray(got + [self.cache.num_pages] * pad, np.int32)
+            )
+            from midgpt_tpu.sampling.disagg import _adopt_pages
+
+            self.cache = _adopt_pages(
+                self.mesh,
+                self.cache,
+                dst,
+                {k: jnp.asarray(b) for k, b in blocks.items()},
+            )
+        slot.pages.extend(got)
+        slot.prompt_pos = slot.length = (start + m) * ps
+        self._prefix_matched_tokens += m * ps  # a cross-tier hit is a hit
+        self.spill_readopted_pages += m
+        self.spill_readopt_events += 1
+        self._trace.instant(
+            "spill.hit", "prefix", self._obs_tid,
+            args={"uid": req.uid, "pages": m},
+        )
+
     def _hot_swap_fault(self) -> None:
         """The `hot_swap_mid_decode` chaos fault: stage whatever weights
         the scenario registered on `swap_source` at this round boundary —
@@ -962,6 +1069,8 @@ class ServeEngine:
             "weights_version": self.weights_version,
             "hot_swaps": self.hot_swaps,
             "resizes": self.resizes,
+            "spill_readopted_pages": self.spill_readopted_pages,
+            "spill_readopt_events": self.spill_readopt_events,
             "swap_pending": self._staged_swap is not None,
             "compile_counts": self.compile_stats(),
             # unified observability schema (docs/OBSERVABILITY.md): round
@@ -1195,6 +1304,8 @@ class ServeEngine:
                     self._prefix_matched_tokens += mr.tokens
                     if mr.cow_truncated:
                         self.cow_pages += 1
+                    if self.spill_tier is not None:
+                        self._readopt_from_spill(slot, req)
                 self.slots[i] = slot
                 self._admitted += 1
                 self._trace.instant(

@@ -15,6 +15,7 @@ import pytest
 from midgpt_tpu.analysis.bench_contract import (
     check_bench_stdout,
     check_serve_bench,
+    check_serve_fleet_bench,
     check_serve_longctx_bench,
     check_serve_ops_bench,
     check_serve_prefix_bench,
@@ -304,6 +305,59 @@ def test_bench_serve_ops_emits_conformant_json_line(capsys):
 
 
 @pytest.mark.slow
+def test_bench_serve_fleet_emits_conformant_json_line(capsys):
+    """--fleet mode: the serve_fleet profile (single engine vs a crashed-
+    replica fleet over the same template trace, with the shared mid-trace
+    trie flush exercising the spill tier) must hold the one-JSON-line
+    contract: a replica actually died, zero streams dropped, every stream
+    bit-matched the single-engine pass, and affinity + spill re-adoption
+    kept the fleet trie hit rate >= the single engine's. Tiny shapes —
+    structure check; docs/ROBUSTNESS.md 'Fleet serving & failover'."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--fleet", "2",
+            "--n-requests", "10",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_fleet")
+    assert not problems, problems
+    assert rec["fleet_size"] == 2 and rec["alive"] == 1
+    assert rec["failovers"] >= 1 and rec["dropped"] == 0
+    assert rec["greedy_match_frac"] == 1.0
+    assert rec["parity_checked"] == 10
+    assert rec["fleet_hit_rate"] >= rec["single_hit_rate"]
+    assert rec["spill_readopted_pages"] >= 1  # the flush spilled, half 2 re-adopted
+    assert rec["spill"]["total_spilled"] >= 1
+    # checker drift behavior on the real record: an unfaulted fleet, a
+    # dropped stream, inexact parity, and a diluted trie are each
+    # contract violations, not numbers
+    assert any("failovers" in p
+               for p in check_serve_fleet_bench(dict(rec, failovers=0)))
+    assert any("dropped" in p
+               for p in check_serve_fleet_bench(dict(rec, dropped=1)))
+    assert any(
+        "greedy_match_frac" in p
+        for p in check_serve_fleet_bench(dict(rec, greedy_match_frac=0.99))
+    )
+    assert any(
+        "hit_rate" in p
+        for p in check_serve_fleet_bench(
+            dict(rec, fleet_hit_rate=rec["single_hit_rate"] / 2 - 0.01)
+        )
+    )
+
+
+@pytest.mark.slow
 def test_loadgen_hot_swap_surfaces_version_transition(capsys):
     """tools/loadgen.py --hot-swap: the serve_slo line still conforms, a
     swap lands at every point, the headline carries the version
@@ -353,6 +407,39 @@ def test_loadgen_prefix_cache_emits_hit_rate(capsys):
     for p in rec["points"]:
         assert 0.0 <= p["prefix_hit_rate"] <= 1.0
     assert 0.0 <= rec["prefix_hit_rate"] <= 1.0
+
+
+def test_loadgen_fleet_emits_fleet_headline(capsys):
+    """tools/loadgen.py --fleet: the serve_slo line still conforms and
+    every point plus the headline carries the fleet availability fields
+    (fleet_size / failovers / spill_hits / fleet-wide prefix_hit_rate) —
+    the serve_slo checker validates their types and ranges whenever
+    fleet_size is present."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "loadgen.py"),
+        [
+            "loadgen.py",
+            "--rates", "30,90",
+            "--n-requests", "4",
+            "--fleet", "2",
+            "--template-frac", "0.75",
+            "--seed", "0",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_slo")
+    assert not problems, problems
+    assert rec["prefix_cache"] is True  # --fleet implies the trie
+    assert rec["fleet_size"] == 2
+    assert rec["failovers"] >= 0 and rec["spill_hits"] >= 0
+    for p in rec["points"]:
+        assert p["fleet_size"] == 2
+        assert p["failovers"] >= 0 and p["spill_hits"] >= 0
+        assert 0.0 <= p["prefix_hit_rate"] <= 1.0
+        assert p["shed"] == 0 and p["completed"] == p["n_offered"]
+    # fleet-field drift is a contract violation once fleet_size appears
+    bad = dict(rec, failovers="1")
+    assert any("failovers" in p for p in check_serve_slo_bench(bad))
 
 
 def test_loadgen_long_mixture_emits_conformant_serve_slo_line(capsys):
@@ -480,6 +567,46 @@ def test_checker_catches_field_drift():
     assert any("value" in p for p in check_train_bench(wrong_type))
     assert any(
         "bench" in p for p in check_serve_bench({"bench": "other"})
+    )
+
+
+def test_serve_fleet_checker_catches_drift():
+    """The serve_fleet gates hold on a synthetic record without running
+    the bench: structural availability claims (a replica died, zero
+    drops, exact parity, undiluted trie) are contract, not numbers."""
+    good = {
+        "bench": "serve_fleet", "backend": "cpu", "n_requests": 12,
+        "total_new_tokens": 120, "fleet_size": 2, "model": {},
+        "kv_dtype": "bf16", "num_pages": 41, "n_templates": 2,
+        "single_tok_s": 100.0, "fleet_tok_s": 90.0,
+        "single_hit_rate": 0.2, "fleet_hit_rate": 0.6,
+        "failovers": 1, "failed_over_streams": 2, "dropped": 0,
+        "parity_checked": 12, "greedy_match_frac": 1.0,
+        "spill_readopted_pages": 10, "spill": {}, "compile_counts": {},
+        "pages_conserved": True,
+    }
+    assert check_serve_fleet_bench(good) == []
+    assert any("fleet_size" in p
+               for p in check_serve_fleet_bench(dict(good, fleet_size=1)))
+    assert any("failovers" in p
+               for p in check_serve_fleet_bench(dict(good, failovers=0)))
+    assert any("dropped" in p
+               for p in check_serve_fleet_bench(dict(good, dropped=1)))
+    assert any(
+        "greedy_match_frac" in p
+        for p in check_serve_fleet_bench(dict(good, greedy_match_frac=0.9999))
+    )
+    assert any(
+        "parity_checked" in p
+        for p in check_serve_fleet_bench(dict(good, parity_checked=11))
+    )
+    assert any(
+        "hit_rate" in p
+        for p in check_serve_fleet_bench(dict(good, fleet_hit_rate=0.1))
+    )
+    assert any(
+        "pages_conserved" in p
+        for p in check_serve_fleet_bench(dict(good, pages_conserved="yes"))
     )
 
 

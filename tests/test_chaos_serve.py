@@ -128,6 +128,55 @@ def test_chaos_pool_resize_grow_shrink_int8_parity():
     assert s["pages_conserved"]
 
 
+def test_chaos_engine_crash_failover_zero_drops():
+    """The fleet gate (docs/ROBUSTNESS.md 'Fleet serving & failover'): a
+    replica killed mid-trace drops ZERO accepted streams — its in-flight
+    work is resubmitted through the retryable path with the original
+    prompt and full budget, and greedy batch-composition-independence
+    makes the failover replays bit-identical to the fault-free single-
+    engine reference. Page conservation holds on every survivor."""
+    s = run_serving_chaos("engine_crash@6", seed=0)
+    assert s["faults_fired"] == {"engine_crash": 1}
+    assert s["fleet_size"] == 2 and s["alive"] == 1
+    assert s["failovers"] == 1
+    assert s["failed_over_streams"] >= 1, "the crash must orphan someone"
+    assert s["dropped_streams"] == 0
+    assert s["statuses"] == {"ok": s["n_requests"]}
+    assert s["parity_ok"] == s["parity_checked"] == s["n_requests"]
+    assert s["pages_conserved"]
+
+
+def test_chaos_handoff_stall_falls_back_to_prefill():
+    """A stalled spill-tier consult costs a re-prefill, never a wrong
+    token: the router refuses the spilled run once (stall_fallbacks), the
+    request recomputes its prefix, and every stream stays bit-identical
+    with the cross-tier ledger closed."""
+    s = run_serving_chaos("handoff_stall", seed=0)
+    assert s["faults_fired"] == {"handoff_stall": 1}
+    assert s["spill"]["stall_fallbacks"] >= 1
+    assert s["dropped_streams"] == 0
+    assert s["statuses"] == {"ok": s["n_requests"]}
+    assert s["parity_ok"] == s["parity_checked"] == s["n_requests"]
+    assert s["pages_conserved"]
+
+
+def test_chaos_spill_corrupt_discards_never_poisons():
+    """Host-RAM corruption of a spilled KV page is caught by the crc32
+    verify at re-adoption and discarded — the page NEVER re-enters the
+    device pool, so no stream can decode from damaged KV. The victim
+    re-prefills; parity stays exact; the spill ledger accounts for the
+    discard (total_spilled = resident + readopted + corrupt_discarded +
+    capacity_dropped + stale_discarded)."""
+    s = run_serving_chaos("spill_corrupt", seed=0)
+    assert s["faults_fired"] == {"spill_corrupt": 1}
+    assert s["spill"]["corrupt_discarded"] >= 1
+    assert s["poisoned"] == 0
+    assert s["dropped_streams"] == 0
+    assert s["statuses"] == {"ok": s["n_requests"]}
+    assert s["parity_ok"] == s["parity_checked"] == s["n_requests"]
+    assert s["pages_conserved"]
+
+
 def test_chaos_run_serve_cli_emits_one_json_line(capsys):
     """`chaos_run.py --serve` holds the one-JSON-line driver contract and
     carries the chaos verdict fields."""

@@ -466,6 +466,171 @@ def _prefix_bench(args, cfg, params, cache_dtype) -> int:
     return 0
 
 
+def _fleet_bench(args, cfg, params, cache_dtype) -> int:
+    """--fleet mode: availability A/B ('serve_fleet' profile,
+    analysis/bench_contract.py; docs/ROBUSTNESS.md 'Fleet serving &
+    failover'). The same template-heavy trace runs through one
+    prefix-cached engine, then through an N-replica FleetRouter with an
+    engine_crash armed mid-trace. Both passes take an identical mid-trace
+    trie flush (a pressure spike force-reclaiming unreferenced pages) at
+    the half-way drain: the single engine loses that KV and re-prefills,
+    while the fleet's replicas spill it to the shared host-RAM tier and
+    the second half re-adopts — which is the tier's throughput story, and
+    puts the checksum/adoption path inside the parity gate. Structural
+    gates: the crash drops zero accepted streams, every fleet stream
+    (survivors and failover replays) bit-matches the single-engine pass,
+    and affinity routing keeps the fleet trie hit rate >= the single
+    engine's instead of diluting toward 1/N (pinned:
+    tests/test_bench_contract.py serve_fleet runner + checker-drift, and
+    the fleet chaos gates in tests/test_chaos_serve.py)."""
+    import jax
+    import numpy as np
+
+    from midgpt_tpu.robustness import faults
+    from midgpt_tpu.sampling.fleet import FleetRouter, assert_fleet_conserved
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    if args.fleet < 2:
+        raise SystemExit("--fleet needs >= 2 replicas (one cannot fail over)")
+
+    rng = np.random.default_rng(args.seed)
+    V = cfg.vocab_size
+    n_templates = args.prefix_templates
+    t_len = args.template_tokens or 5 * args.page_size
+    templates = [
+        rng.integers(0, V, t_len, dtype=np.int64) for _ in range(n_templates)
+    ]
+    trace = []
+    for i in range(args.n_requests):
+        tail = rng.integers(0, V, int(rng.integers(3, 9)), dtype=np.int64)
+        prompt = np.concatenate([templates[i % n_templates], tail])
+        trace.append((prompt, int(rng.integers(8, 13))))
+    total_new = sum(m for _, m in trace)
+    half = len(trace) // 2
+    # 41: a fresh program-key pool geometry (see chaos_serve._engine's pin
+    # note), roomy enough that max_slots full requests fit without
+    # thrashing while the trie still feels pressure across the trace
+    num_pages = 41
+
+    def mk_engine(**kw):
+        return ServeEngine(
+            cfg,
+            params,
+            max_slots=args.max_slots,
+            page_size=args.page_size,
+            num_pages=num_pages,
+            prefill_chunk=args.prefill_chunk,
+            decode_chunk=args.decode_chunk,
+            temperature=0.0,
+            cache_dtype=cache_dtype,
+            prefix_cache=True,
+            **kw,
+        )
+
+    def run_single():
+        faults.clear()
+        eng = mk_engine()
+        t0 = time.perf_counter()
+        uids = [eng.submit(p, m) for p, m in trace[:half]]
+        eng.run()
+        eng._evict_shared_prefix_fault()  # the shared mid-trace flush
+        uids += [eng.submit(p, m) for p, m in trace[half:]]
+        eng.run()
+        return eng, uids, time.perf_counter() - t0
+
+    run_single()  # warm every jit shape at this geometry
+    eng_single, single_uids, dt_single = run_single()
+    single_tokens = {
+        idx: np.asarray(eng_single.finished[uid].tokens)
+        for idx, uid in enumerate(single_uids)
+    }
+    single_hit = eng_single.prefix_stats()["hit_rate"]
+
+    faults.clear()
+    faults.activate("engine_crash", step=args.fleet_crash_round)
+    router = FleetRouter(
+        [mk_engine(obs_tid=f"replica{i}") for i in range(args.fleet)]
+    )
+
+    def drive(pending, r):
+        # trickled one per round so the crash finds streams in flight
+        while pending or not router.idle:
+            if pending:
+                idx, (p, m) = pending.pop(0)
+                uid_to_idx[router.submit_retry(p, m)] = idx
+            router.step()
+            r += 1
+            if r >= 100_000:
+                raise SystemExit("fleet drive did not converge")
+        return r
+
+    uid_to_idx: dict = {}
+    t0 = time.perf_counter()
+    r = drive(list(enumerate(trace[:half])), 0)
+    for i, rep in enumerate(router.engines):
+        if router.alive[i]:
+            rep._evict_shared_prefix_fault()  # same flush — but spilled
+    drive(list(enumerate(trace[half:], start=half)), r)
+    dt_fleet = time.perf_counter() - t0
+    faults.clear()
+    assert_fleet_conserved(router, "fleet bench")
+
+    match = total = dropped = parity_checked = 0
+    for uid, idx in uid_to_idx.items():
+        fr = router.finished.get(uid)
+        if fr is None or fr.status != "ok":
+            dropped += 1
+            continue
+        parity_checked += 1
+        a = np.asarray(fr.tokens)
+        b = single_tokens[idx]
+        n = min(len(a), len(b))
+        match += int(np.sum(a[:n] == b[:n]))
+        total += max(len(a), len(b))
+
+    print(
+        json.dumps(
+            {
+                "bench": "serve_fleet",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "fleet_size": args.fleet,
+                "max_slots": args.max_slots,
+                "page_size": args.page_size,
+                "kv_dtype": args.kv_dtype,
+                "num_pages": num_pages,
+                "n_templates": n_templates,
+                "template_tokens": t_len,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "single_tok_s": round(total_new / dt_single, 2),
+                "fleet_tok_s": round(total_new / dt_fleet, 2),
+                "single_hit_rate": round(single_hit, 4),
+                "fleet_hit_rate": round(router.prefix_hit_rate(), 4),
+                "failovers": router.failovers,
+                "failed_over_streams": router.failed_over_streams,
+                "crash_round": args.fleet_crash_round,
+                "alive": sum(router.alive),
+                "dropped": dropped,
+                "parity_checked": parity_checked,
+                "greedy_match_frac": round(match / max(total, 1), 4),
+                "spill_readopted_pages": sum(
+                    e.spill_readopted_pages for e in router.engines
+                ),
+                "spill": router.spill.stats(),
+                "pages_conserved": True,
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def _longctx_bench(args) -> int:
     """--long-ctx mode: the split-K decode A/B ('serve_longctx' profile,
     analysis/bench_contract.py).
@@ -860,6 +1025,19 @@ def main() -> int:
                     "exactly 1.0 ('serve_tp' JSON profile). Pair with "
                     "--cpu-devices 8 on this host (docs/SERVING.md "
                     "'Mesh-sharded serving')")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help=">= 2 selects the fleet availability A/B: the same "
+                    "template trace through one prefix-cached engine and "
+                    "through N replicas behind the prefix-affinity "
+                    "FleetRouter with an engine_crash armed mid-trace — "
+                    "zero dropped streams, bit-exact parity (failover "
+                    "replays and host-RAM spill re-adoption included), and "
+                    "fleet trie hit rate >= the single engine's. Emits the "
+                    "'serve_fleet' JSON profile (docs/ROBUSTNESS.md 'Fleet "
+                    "serving & failover')")
+    ap.add_argument("--fleet-crash-round", type=int, default=6,
+                    help="--fleet: router round at which the armed "
+                    "engine_crash kills the busiest replica")
     ap.add_argument("--prefix-templates", type=int, default=2,
                     help="distinct shared system prompts in the workload")
     ap.add_argument("--template-tokens", type=int, default=0,
@@ -938,7 +1116,12 @@ def main() -> int:
         return _longctx_bench(args)
 
     train_loss = None
-    if quantized and not args.spec and not args.shared_prefix_frac and not args.tp:
+    if (
+        quantized and not args.spec and not args.shared_prefix_frac
+        and not args.tp and not args.fleet
+        # (fleet parity, like prefix parity, compares same-dtype runs —
+        # exact bitwise, nothing for a quick fit to make meaningful)
+    ):
         # (the prefix bench skips the fit: its greedy_match_frac compares
         # cache-on vs cache-off at the SAME dtype, which is exact bitwise
         # — no numeric perturbation for training to make meaningful)
@@ -951,6 +1134,9 @@ def main() -> int:
 
     if args.shared_prefix_frac:
         return _prefix_bench(args, cfg, params, cache_dtype)
+
+    if args.fleet:
+        return _fleet_bench(args, cfg, params, cache_dtype)
 
     # Mixed-length trace: short chat-y prompts to near-context documents.
     rng = np.random.default_rng(args.seed)

@@ -24,6 +24,17 @@ on an int8 cache, both with bit-exact greedy parity and zero drops:
     python tools/chaos_run.py --serve --fault hot_swap_mid_decode@5
     python tools/chaos_run.py --serve --fault pool_resize@4 --fault pool_resize@8
 
+Fleet gates (docs/ROBUSTNESS.md "Fleet serving & failover") — the trace
+runs through TWO replicas behind the prefix-affinity FleetRouter with its
+shared host-RAM spill tier (sampling/fleet.py): a mid-trace replica kill
+drops zero accepted streams (failovers replay bit-identically on the
+survivor), and a stalled or corrupted spill page costs a re-prefill, never
+a token, with page conservation extended across replicas and tiers:
+
+    python tools/chaos_run.py --serve --fault engine_crash@6
+    python tools/chaos_run.py --serve --fault handoff_stall
+    python tools/chaos_run.py --serve --fault spill_corrupt
+
 `--list-faults` prints the registered kinds with one-line descriptions;
 unknown `--fault` kinds fail up front with that same list.
 

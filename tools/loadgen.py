@@ -12,7 +12,12 @@ system-prompt traffic), drives the asyncio front door
 point, and emits ONE JSON line (driver contract, `serve_slo` profile in
 analysis/bench_contract.py). With `--prefix-cache` the engines run with
 the cross-request prefix cache on and per-point/headline
-`prefix_hit_rate` fields report how much prefill the trie absorbed:
+`prefix_hit_rate` fields report how much prefill the trie absorbed. With
+`--fleet N` each point instead drives N replica engines behind the
+prefix-affinity FleetRouter with its shared host-RAM KV spill tier
+(sampling/fleet.py; docs/ROBUSTNESS.md "Fleet serving & failover") through
+a synchronous step loop, and points + headline carry fleet_size /
+failovers / fleet-wide prefix_hit_rate / spill_hits:
 
     python tools/loadgen.py --process poisson --rates 20,60 \
         [--scheduler slo] [--ttl-s 2.0] [--slo-ttft-ms 500 --slo-tpot-ms 50] \
@@ -197,6 +202,77 @@ async def _drive_point(server, reqs, arrivals, ttl_s):
     return records
 
 
+def _drive_fleet_point(router, reqs, arrivals, ttl_s, submit_retries=8):
+    """One offered-load point against a FleetRouter, driven synchronously:
+    the router's step loop IS the clock (sampling/fleet.py — replicas are
+    in-process engines, so an asyncio front door would add nothing but
+    scheduling noise). Arrivals submit when their offset passes, under a
+    bounded per-request retry budget — a request still refused after
+    `submit_retries` attempts stays a shed, mirroring the async path's
+    bounded-retry front door. TTFT runs from the FIRST submit attempt
+    (admission retries and queueing included, same client-perceived
+    definition as _drive_point); token times ride the router's on_token
+    relay, so across a failover the replayed stream's delivery is
+    at-least-once and TPOT is measured over everything the client saw."""
+    from midgpt_tpu.sampling.serve import BackpressureError
+
+    t0 = time.perf_counter()
+    records = [
+        {"i": i, "status": "shed", "ttft_s": None, "tpot_s": None}
+        for i in range(len(reqs))
+    ]
+    first_attempt: tp.Dict[int, float] = {}
+    token_times: tp.Dict[int, tp.List[float]] = {}
+    uid_to_i: tp.Dict[int, int] = {}
+
+    def on_token(uid, tok, t):
+        token_times.setdefault(uid, []).append(time.perf_counter())
+
+    router.on_token = on_token
+    order = sorted(range(len(reqs)), key=lambda i: arrivals[i])
+    qi = 0
+    waiting: tp.List[tp.List[int]] = []  # [request index, attempts so far]
+    guard = 0
+    while qi < len(order) or waiting or not router.idle:
+        guard += 1
+        if guard >= 1_000_000:
+            raise SystemExit("fleet point did not converge")
+        now = time.perf_counter() - t0
+        while qi < len(order) and arrivals[order[qi]] <= now:
+            waiting.append([order[qi], 0])
+            qi += 1
+        still: tp.List[tp.List[int]] = []
+        for item in waiting:
+            i = item[0]
+            first_attempt.setdefault(i, time.perf_counter())
+            try:
+                uid = router.submit(reqs[i][0], reqs[i][1], ttl_s=ttl_s)
+            except BackpressureError as e:
+                item[1] += 1
+                if item[1] < submit_retries and getattr(e, "retryable", False):
+                    still.append(item)
+                continue  # budget exhausted / terminal: stays "shed"
+            uid_to_i[uid] = i
+        waiting = still
+        if qi < len(order) and router.idle and not waiting:
+            # quiet fleet, next arrival in the future: sleep up to it
+            delay = arrivals[order[qi]] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            continue
+        router.step()
+    for uid, i in uid_to_i.items():
+        fr = router.finished.get(uid)
+        rec = records[i]
+        rec["status"] = fr.status if fr is not None else "lost"
+        times = token_times.get(uid, [])
+        if times:
+            rec["ttft_s"] = times[0] - first_attempt[i]
+            if len(times) > 1:
+                rec["tpot_s"] = (times[-1] - times[0]) / (len(times) - 1)
+    return records
+
+
 def _point_stats(rate, records, error_budget, slo_ttft_ms, slo_tpot_ms):
     n = len(records)
     shed = sum(1 for r in records if r["status"] == "shed")
@@ -282,6 +358,16 @@ def main() -> int:
                     "weights_version transition; the SLO acceptance is the "
                     "curve staying inside the error budget THROUGH the "
                     "swap — same slo_ok computation, no special-casing")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help=">= 2 runs every point against that many replica "
+                    "engines behind the prefix-affinity FleetRouter "
+                    "(sampling/fleet.py) with its shared host-RAM spill "
+                    "tier, driven synchronously (the router step loop is "
+                    "the clock). Implies --prefix-cache (the trie is the "
+                    "affinity target). Points and headline carry "
+                    "fleet_size / failovers / fleet-wide prefix_hit_rate "
+                    "/ spill_hits (docs/ROBUSTNESS.md 'Fleet serving & "
+                    "failover'). Incompatible with --hot-swap and --tp")
     # engine/model shape (tiny defaults: the CPU-mesh scheduling testbed)
     ap.add_argument("--max-slots", type=int, default=3)
     ap.add_argument("--page-size", type=int, default=8)
@@ -313,6 +399,12 @@ def main() -> int:
                     "distinguishable. Pair with --cpu-devices >= N")
     args = ap.parse_args()
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    if args.fleet:
+        if args.fleet < 2:
+            ap.error("--fleet needs >= 2 replicas (one cannot fail over)")
+        if args.hot_swap or args.tp:
+            ap.error("--fleet is incompatible with --hot-swap and --tp")
+        args.prefix_cache = True  # the router's affinity target
     if not args.num_pages:
         pages_per_slot = -(-args.block_size // args.page_size)
         args.num_pages = (
@@ -359,7 +451,7 @@ def main() -> int:
             raise SystemExit(f"--tp {args.tp} must divide n_head {cfg.n_head}")
         mesh = make_serve_mesh(tp_size=args.tp)
 
-    def make_engine(obs=None):
+    def make_engine(obs=None, obs_tid="engine"):
         sched = (
             SLOScheduler(min_headroom_s=args.min_headroom_s)
             if args.scheduler == "slo"
@@ -368,6 +460,7 @@ def main() -> int:
         return ServeEngine(
             cfg,
             params,
+            obs_tid=obs_tid,
             max_slots=args.max_slots,
             page_size=args.page_size,
             num_pages=args.num_pages,
@@ -453,6 +546,55 @@ def main() -> int:
         # per-offered-load numbers, and a dumped trace must cover exactly
         # one point to be readable.
         obs = Observability()
+        if args.fleet:
+            from midgpt_tpu.sampling.fleet import (
+                FleetRouter,
+                assert_fleet_conserved,
+            )
+
+            # One recorder across the replicas (distinct tids): the
+            # decomposition is a fleet-wide round picture for this point.
+            router = FleetRouter(
+                [
+                    make_engine(obs, obs_tid=f"replica{k}")
+                    for k in range(args.fleet)
+                ]
+            )
+            records = _drive_fleet_point(
+                router, reqs, arrivals, args.ttl_s or None
+            )
+            assert_fleet_conserved(router, f"loadgen point {pi}")
+            stats = _point_stats(
+                rate, records, args.error_budget,
+                args.slo_ttft_ms, args.slo_tpot_ms,
+            )
+            stats["fleet_size"] = args.fleet
+            stats["failovers"] = router.failovers
+            stats["spill_hits"] = router.spill.readopted
+            stats["prefix_hit_rate"] = round(router.prefix_hit_rate(), 4)
+            decomp = obs.round_decomp()
+            stats["rounds"] = decomp["rounds"]
+            stats["round_host_ms"] = {
+                "p50": round(
+                    decomp["dispatch"]["p50_ms"]
+                    + decomp["host_post"]["p50_ms"], 3
+                ),
+                "p95": round(
+                    decomp["dispatch"]["p95_ms"]
+                    + decomp["host_post"]["p95_ms"], 3
+                ),
+            }
+            stats["round_device_ms"] = {
+                "p50": decomp["device_wait"]["p50_ms"],
+                "p95": decomp["device_wait"]["p95_ms"],
+            }
+            if args.trace_out:
+                obs.dump(
+                    args.trace_out,
+                    filename=f"loadgen_point{pi}_r{rate:g}.json",
+                )
+            points.append(stats)
+            continue
         engine = make_engine(obs)
         server = AsyncServeServer(engine, idle_poll_s=0.001)
 
@@ -565,6 +707,13 @@ def main() -> int:
                 "round_host_ms": worst["round_host_ms"],
                 "round_device_ms": worst["round_device_ms"],
                 "prefix_hit_rate": worst.get("prefix_hit_rate"),
+                # --fleet: availability/affinity headline from the hottest
+                # point (docs/ROBUSTNESS.md "Fleet serving & failover");
+                # prefix_hit_rate above is then the FLEET-wide rate, the
+                # number affinity routing exists to protect
+                "fleet_size": args.fleet or None,
+                "failovers": worst.get("failovers") if args.fleet else None,
+                "spill_hits": worst.get("spill_hits") if args.fleet else None,
                 # --hot-swap: the version transition every point rode
                 # (docs/ROBUSTNESS.md 'Zero-downtime model ops'); slo_ok
                 # below is then the "curve stays flat through the swap"
