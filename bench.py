@@ -74,7 +74,59 @@ def main() -> int:
     parser.add_argument("--attn-layout", type=str, default=None,
                         choices=["seq", "head"],
                         help="attention activation layout override")
+    parser.add_argument("--probe-deadline", type=float, default=60.0,
+                        help="backend reachability budget in seconds (0 "
+                        "disables): a trivial dispatch must land within it, "
+                        "else one {'error': 'backend_unreachable'} JSON line "
+                        "comes out instead of a silent hang")
     args = parser.parse_args()
+
+    if args.probe_deadline > 0:
+        # A dead TPU tunnel wedges the first device sync FOREVER, and the
+        # driver historically saw nothing until its own timeout killed the
+        # bench with an empty stdout. Bound a trivial dispatch with the
+        # hung-step watchdog BEFORE paying the model build: an unreachable
+        # backend becomes one machine-readable error line within the budget.
+        from midgpt_tpu.robustness import faults
+        from midgpt_tpu.robustness.errors import StepHangError
+        from midgpt_tpu.robustness.watchdog import StepWatchdog
+
+        if os.environ.get("MIDGPT_FAULTS"):
+            faults.activate_plan(os.environ["MIDGPT_FAULTS"])
+
+        def _probe() -> float:
+            if faults.should_fire("hang_step"):
+                # Contract-test hook: model the dead tunnel in-process (the
+                # force below never returns), same never-set-event shape as
+                # the train-loop fault.
+                import threading
+
+                threading.Event().wait()
+            import jax.numpy as jnp
+
+            # Touch the backend end to end: placement + compute + host sync.
+            return float(jnp.zeros((8, 128)).sum())
+
+        try:
+            StepWatchdog(args.probe_deadline).sync(
+                _probe, label="bench.backend_probe"
+            )
+        except StepHangError:
+            print(json.dumps({
+                "error": "backend_unreachable",
+                "metric": "train_mfu",
+                "value": None,
+                "detail": {
+                    "probe_deadline_s": args.probe_deadline,
+                    "platform_requested": os.environ.get(
+                        "MIDGPT_PLATFORM", "(default: tpu tunnel)"
+                    ),
+                    "hint": "the device backend did not answer a trivial "
+                    "dispatch inside the probe budget — dead axon tunnel or "
+                    "wedged runtime; restart the tunnel and re-run",
+                },
+            }))
+            return 1
 
     from midgpt_tpu.config import MeshConfig
 

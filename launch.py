@@ -140,7 +140,28 @@ def main() -> None:
     # a clean exit (a second signal hard-kills). The supervisor adds
     # restart-on-divergence with data-window skip (docs/ROBUSTNESS.md).
     preempt.install_handlers()
-    supervise(config)
+    runtime = None
+    mesh_product = config.mesh.data * config.mesh.fsdp * config.mesh.sp
+    if (
+        config.on_resume_mesh == "any"
+        and config.mesh.data != -1
+        and mesh_product != jax.device_count()
+    ):
+        # Elastic resume surface (docs/ROBUSTNESS.md "Elastic resume &
+        # watchdog"): the configured mesh doesn't fit what the scheduler
+        # handed us, and the config opted into topology changes — build the
+        # runtime with the data axis re-derived for the ACTUAL device count
+        # (the supervisor then reshard-restores the checkpoint through the
+        # new mesh's shardings).
+        from midgpt_tpu.training.train import make_runtime
+
+        print(
+            f"elastic resume: configured mesh wants {mesh_product} device(s), "
+            f"found {jax.device_count()}; re-deriving the data axis "
+            "(on_resume_mesh='any')"
+        )
+        runtime = make_runtime(config, devices=list(jax.devices()))
+    supervise(config, runtime=runtime)
 
 
 if __name__ == "__main__":

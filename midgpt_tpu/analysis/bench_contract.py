@@ -697,6 +697,76 @@ def check_serve_slo_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_train_chaos(rec: dict) -> tp.List[str]:
+    """tools/chaos_run.py degraded-IO / elastic-topology summary
+    (docs/ROBUSTNESS.md "Elastic resume & watchdog"): a supervised training
+    run with hang_step / ckpt_enospc / resume_reshard armed. The record
+    carries the recovery claim, so its gates are structural:
+
+      * status == "ok" and at least one requested fault actually FIRED —
+        an unfaulted pass claims nothing about recovery.
+      * detected_at_ms is a number >= 0 (the registry observer timestamped
+        the first firing; a null means the plan never triggered).
+      * loss_parity is literal true — the post-recovery trajectory matches
+        an unfaulted reference run of the same config (rtol covers only
+        the f32 reassociation of a re-derived data-axis all-reduce after
+        a mesh change; the batch order is positional and exact).
+      * final_mesh names the geometry the run FINISHED on (axes + device
+        count) so a resume_reshard record proves the topology actually
+        changed hands."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "tool": (str,),
+            "bench": (str,),
+            "status": (str,),
+            "wall_s": Number,
+            "faults_requested": (list,),
+            "faults_fired": (dict,),
+            "detected_at_ms": Number,
+            "restarts": (int,),
+            "final_mesh": (dict,),
+            "n_devices_final": (int,),
+            "loss_final": Number,
+        },
+        problems,
+    )
+    if rec.get("bench") != "train_chaos":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'train_chaos'"
+        )
+    if rec.get("status") != "ok":
+        problems.append(
+            f"status {rec.get('status')!r} != 'ok' — recovery did not complete"
+        )
+    fired = rec.get("faults_fired")
+    if isinstance(fired, dict) and sum(fired.values()) < 1:
+        problems.append(
+            "faults_fired is empty — no fault fired, the recovery claim is vacuous"
+        )
+    d = rec.get("detected_at_ms")
+    if isinstance(d, Number) and d < 0:
+        problems.append(f"detected_at_ms {d} < 0")
+    if rec.get("loss_parity") is not True:
+        problems.append(
+            "field 'loss_parity' must be literal true — the recovered "
+            "trajectory must match the unfaulted reference run"
+        )
+    fm = rec.get("final_mesh")
+    if isinstance(fm, dict):
+        if not isinstance(fm.get("n_devices"), int) or fm["n_devices"] < 1:
+            problems.append(
+                f"final_mesh.n_devices {fm.get('n_devices')!r} must be an int >= 1"
+            )
+        if not isinstance(fm.get("axes"), dict) or not fm.get("axes"):
+            problems.append("final_mesh.axes must be a non-empty object")
+    r = rec.get("restarts")
+    if isinstance(r, int) and r < 0:
+        problems.append(f"restarts {r} < 0")
+    return problems
+
+
 def check_graftcheck(rec: dict) -> tp.List[str]:
     """The graftcheck CLI's own --json line."""
     problems: tp.List[str] = []
@@ -736,6 +806,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "serve_ops": check_serve_ops_bench,
     "serve_fleet": check_serve_fleet_bench,
     "serve_slo": check_serve_slo_bench,
+    "train_chaos": check_train_chaos,
     "graftcheck": check_graftcheck,
 }
 

@@ -126,6 +126,29 @@ class ExperimentConfig:
     # Fault-injection plan ("kind[@step][*times],..." — robustness/faults.py),
     # activated once per supervised run; "" (default) injects nothing.
     fault_plan: str = ""
+    # Hung-step watchdog (robustness/watchdog.py): deadline in seconds armed
+    # around each of the train loop's device syncs (the t_land force points).
+    # 0.0 (default) disables the guard entirely — the sync is a plain call,
+    # no thread, no clock read. Production tunnel runs want ~300s (a few
+    # compiles' worth of slack above the longest healthy step).
+    watchdog_deadline_s: float = 0.0
+    # What an expired watchdog does after dumping the flight recorder:
+    # 'raise' raises StepHangError (the supervisor restarts from the last
+    # verified checkpoint, like a divergence); 'exit' hard-exits with
+    # watchdog.EXIT_CODE for a cluster layer that restarts whole processes.
+    watchdog_escalate: str = "raise"
+    # Topology-change policy when a supervised run resumes onto a mesh with
+    # a different device count than the ledger recorded (elastic resume —
+    # docs/ROBUSTNESS.md "Elastic resume & watchdog"): 'same' (default)
+    # refuses loudly; 'any' re-derives the data/fsdp axes and restores the
+    # checkpoint through the new mesh's shardings.
+    on_resume_mesh: str = "same"
+    # Grace budget for the SIGTERM emergency save, seconds from the signal's
+    # arrival. If the step boundary where the save WOULD start is already
+    # past the budget, the save is skipped loudly (ledger note + flight-
+    # recorder dump) instead of being killed mid-write and leaving an
+    # unverified partial. 0.0 (default) = unbounded (always attempt).
+    preempt_grace_s: float = 0.0
     # ---- speculative decoding (sampling/spec.py, docs/SERVING.md) ----
     # Self-draft depth for sampling/serving: the first spec_layers blocks of
     # the model (sharing its embeddings/lm_head) propose tokens that the
@@ -348,6 +371,28 @@ class ExperimentConfig:
             )
         if self.restart_backoff_sec < 0 or self.ckpt_retry_backoff_sec < 0:
             raise ValueError("backoff seconds must be >= 0")
+        if self.watchdog_deadline_s < 0:
+            # Negative would arm a guard that expires before the first poll
+            # — every step "hangs". 0 is the documented off switch.
+            raise ValueError(
+                f"watchdog_deadline_s={self.watchdog_deadline_s} must be "
+                ">= 0 (0 disables the watchdog)"
+            )
+        if self.watchdog_escalate not in ("raise", "exit"):
+            raise ValueError(
+                f"unknown watchdog_escalate {self.watchdog_escalate!r} "
+                "('raise' or 'exit')"
+            )
+        if self.on_resume_mesh not in ("same", "any"):
+            raise ValueError(
+                f"unknown on_resume_mesh {self.on_resume_mesh!r} "
+                "('same' or 'any')"
+            )
+        if self.preempt_grace_s < 0:
+            raise ValueError(
+                f"preempt_grace_s={self.preempt_grace_s} must be >= 0 "
+                "(0 = unbounded)"
+            )
         if mc.attn_impl == "ulysses":
             # Ulysses re-shards heads over sp (after any tp head sharding):
             # every (tp, sp) device needs whole heads.

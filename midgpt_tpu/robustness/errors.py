@@ -34,6 +34,31 @@ class DivergenceError(FloatingPointError):
         self.rundir = rundir
 
 
+class StepHangError(RuntimeError):
+    """A watchdog-guarded device sync did not land inside its deadline
+    (robustness/watchdog.py) — the tunnel-down / wedged-dispatch failure
+    mode that otherwise stalls a run forever (the r14/r18 bench hangs).
+
+    `step` is the loop iteration whose sync was armed (None for
+    non-training guards, e.g. the bench backend probe); `waited_s` is how
+    long the watchdog's clock says it waited before giving up, which is
+    >= the configured deadline by at most one poll interval.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        step: tp.Optional[int] = None,
+        waited_s: float = 0.0,
+        rundir: str = "",
+    ):
+        super().__init__(message)
+        self.step = step
+        self.waited_s = waited_s
+        self.rundir = rundir
+
+
 class CheckpointCorruptError(ValueError):
     """A checkpoint failed its manifest verification (missing/truncated/
     bit-flipped item). `problems` lists one human-readable line per

@@ -25,6 +25,23 @@ via tools/chaos_run.py):
   preempt            set the preemption flag at data step k, as if SIGTERM
                      arrived mid-step — drives the emergency-save path
                      without depending on signal-delivery timing.
+  hang_step          the step's device sync at data step k never lands (the
+                     tunnel-down / wedged-dispatch failure): the guarded
+                     float() blocks on a never-set event, so only the
+                     hung-step watchdog (robustness/watchdog.py) can end
+                     the wait — dump, ledger HUNG mark, escalation.
+  ckpt_enospc        the next N checkpoint-save attempts fail with
+                     OSError(ENOSPC) after partial bytes land in the step
+                     directory — disk exhaustion mid-write. The atomic
+                     manifest commit must leave no partial step visible to
+                     latest_verified_step, the retry/backoff path must
+                     recover when space frees, and verified-only GC must
+                     never delete the last good checkpoint over it.
+  resume_reshard     request a preemption exit at data step k so the driver
+                     (tools/chaos_run.py) can restart the run on a DIFFERENT
+                     device count — the cross-mesh resharding resume path
+                     (train restores the checkpoint through the new mesh's
+                     shardings; supervise checks on_resume_mesh).
 
 Serving kinds (hooked in sampling/serve.py `ServeEngine.step`, the async
 front door sampling/server.py, and the chaos scenario driver
@@ -111,6 +128,9 @@ KINDS = (
     "kill_mid_save",
     "truncate_ckpt_item",
     "preempt",
+    "hang_step",
+    "ckpt_enospc",
+    "resume_reshard",
     # serving (sampling/serve.py, sampling/server.py, chaos_serve.py)
     "kill_mid_decode",
     "poisoned_page",
@@ -134,6 +154,9 @@ DESCRIPTIONS: tp.Dict[str, str] = {
     "kill_mid_save": "truncate one ckpt item + die before the manifest lands",
     "truncate_ckpt_item": "corrupt one ckpt item AFTER its manifest committed",
     "preempt": "set the preemption flag at data step k (SIGTERM mid-step)",
+    "hang_step": "the step's device sync never lands; the watchdog must end it",
+    "ckpt_enospc": "ENOSPC mid checkpoint write, partial bytes left behind",
+    "resume_reshard": "preempt at data step k; driver restarts on another mesh",
     "kill_mid_decode": "the round's decode dispatch dies; slots recompute-preempt",
     "poisoned_page": "corrupt one live slot's pool page in place (HBM damage)",
     "slow_client": "a streaming client stops draining; bounded buffer sheds it",
@@ -158,6 +181,16 @@ class Fault:
 
 
 _active: tp.List[Fault] = []
+
+# Optional firing observer (tools/chaos_run.py timestamps detection latency
+# with it — the wall clock stays in tools/, keeping this module free of
+# clock reads per the GC012 discipline). Called once per consumed firing.
+_on_fire: tp.Optional[tp.Callable[[Fault], None]] = None
+
+
+def set_on_fire(cb: tp.Optional[tp.Callable[[Fault], None]]) -> None:
+    global _on_fire
+    _on_fire = cb
 
 
 def activate(kind: str, *, step: tp.Optional[int] = None, times: int = 1) -> Fault:
@@ -189,7 +222,9 @@ def activate_plan(plan: str) -> tp.List[Fault]:
 
 
 def clear() -> None:
+    global _on_fire
     _active.clear()
+    _on_fire = None
 
 
 def active() -> tp.List[Fault]:
@@ -215,5 +250,7 @@ def should_fire(kind: str, *, step: tp.Optional[int] = None) -> bool:
             continue
         f.times -= 1
         f.fired += 1
+        if _on_fire is not None:
+            _on_fire(f)
         return True
     return False

@@ -15,18 +15,31 @@ handler is restored, so a second SIGINT still hard-kills a wedged run.
 from __future__ import annotations
 
 import signal
+import time
 import typing as tp
 
 import numpy as np
 
 _requested = False
+_requested_at: tp.Optional[float] = None
 _previous: tp.Dict[int, tp.Any] = {}
 
 
-def request(signum: tp.Optional[int] = None, frame: tp.Any = None) -> None:
-    """Mark a preemption (the signal handler; also callable directly)."""
-    global _requested
+def request(
+    signum: tp.Optional[int] = None,
+    frame: tp.Any = None,
+    _clock: tp.Callable[[], float] = time.monotonic,
+) -> None:
+    """Mark a preemption (the signal handler; also callable directly).
+
+    Records the arrival time on the injected clock so the train loop can
+    hold its `preempt_grace_s` budget: an emergency save that would START
+    after the grace window is skipped loudly rather than being SIGKILLed
+    mid-write (training/train.py)."""
+    global _requested, _requested_at
     _requested = True
+    if _requested_at is None:  # first signal wins; re-delivery keeps it
+        _requested_at = _clock()
     if signum is not None and signum in _previous:
         # One-shot: a second signal reaches the previous (default) handler.
         signal.signal(signum, _previous.pop(signum))
@@ -37,9 +50,17 @@ def requested() -> bool:
     return _requested
 
 
+def requested_at() -> tp.Optional[float]:
+    """Monotonic timestamp of the first preemption request (None if none).
+    Same clock family as `request`'s default, so `clock() - requested_at()`
+    is the elapsed grace the train loop compares to `preempt_grace_s`."""
+    return _requested_at
+
+
 def reset() -> None:
-    global _requested
+    global _requested, _requested_at
     _requested = False
+    _requested_at = None
     for signum, prev in list(_previous.items()):
         signal.signal(signum, prev)
     _previous.clear()

@@ -476,6 +476,7 @@ class ServeEngine:
         obs: tp.Optional[Observability] = None,
         obs_tid: str = "engine",
         weights_version: str = "inline",
+        watchdog=None,  # Optional[robustness.watchdog.StepWatchdog]
     ):
         assert decode_chunk & (decode_chunk - 1) == 0, "decode_chunk: power of two"
         # ---- tp serving mesh (docs/SERVING.md "Mesh-sharded serving") ----
@@ -530,6 +531,12 @@ class ServeEngine:
         self.obs = obs
         self._trace = obs.tracer if obs is not None else NULL_TRACER
         self._obs_tid = obs_tid
+        # Hung-dispatch watchdog (robustness/watchdog.py), same injection
+        # discipline as clock/obs: None (default) leaves the decode round's
+        # force a plain np.asarray — no thread, no event, nothing for the
+        # recompile pins to see. Set, it bounds the round's device sync so a
+        # wedged tunnel ends in StepHangError instead of a hung server.
+        self.watchdog = watchdog
         self.on_token = on_token
         self.on_finish = on_finish
         self.page_size = page_size
@@ -1616,7 +1623,14 @@ class ServeEngine:
             self._split_bucket(round_span),
         )
         t1 = 0.0 if obs is None else self._clock()
-        toks = np.asarray(toks)  # (n, B) — forces the dispatch
+        if self.watchdog is not None:
+            # Arm the deadline around the round's ONE host<->device sync —
+            # the force below is where a dead tunnel would wedge forever.
+            toks = self.watchdog.sync(
+                lambda: np.asarray(toks), label="serve.decode_sync"
+            )
+        else:
+            toks = np.asarray(toks)  # (n, B) — forces the dispatch
         t_done = self._clock()
         for i in active_idx:
             slot = self.slots[i]

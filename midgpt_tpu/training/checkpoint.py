@@ -18,7 +18,12 @@ Fault tolerance (docs/ROBUSTNESS.md):
   * **Write retry.** The synchronous part of a save (queueing the
     TensorStore write) retries `write_retries` times with exponential
     backoff before raising CheckpointWriteError — a transient filesystem
-    hiccup must not kill a run that has hours of state in memory.
+    hiccup must not kill a run that has hours of state in memory. Disk
+    exhaustion (the `ckpt_enospc` fault: ENOSPC after partial bytes land)
+    rides the same schedule; the partial, un-manifested step directory is
+    swept before each retry and on budget exhaustion, so it is never
+    visible to `latest_verified_step` and never shadows the last good
+    checkpoint.
   * **Checksum manifests.** After an async save lands, a per-file sha256
     manifest is committed (atomic rename) into the step directory. A step
     is *verified* iff every file matches its manifest. `restore` re-verifies
@@ -40,9 +45,11 @@ is no reader for other orbax layouts.
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import os
+import shutil
 import typing as tp
 
 import jax
@@ -284,6 +291,22 @@ class CheckpointManager:
                     "injected transient checkpoint-write failure "
                     "(faults: ckpt_io_error)"
                 )
+            if faults.should_fire("ckpt_enospc"):
+                # Disk exhaustion mid-write: partial bytes land in the step
+                # directory (no manifest — the atomic commit never ran),
+                # then the write dies with ENOSPC. The retry below must
+                # first sweep the partial so a recovered attempt starts
+                # from a clean step dir.
+                if self._local:
+                    d = os.path.join(self._dir, str(step))
+                    os.makedirs(d, exist_ok=True)
+                    with open(os.path.join(d, "partial_item.bin"), "wb") as fh:
+                        fh.write(b"\x00" * 1024)
+                raise OSError(
+                    errno.ENOSPC,
+                    "injected ENOSPC mid checkpoint write (faults: ckpt_enospc)",
+                )
+            self._clear_partial(step)
             return self._mngr.save(step, args=args, force=True)
 
         try:
@@ -302,6 +325,11 @@ class CheckpointManager:
                     retry_on=(OSError,),  # includes IOError; TensorStore failures
                 )
         except OSError as e:
+            # Budget exhausted: sweep any partial bytes a failed attempt
+            # left (ENOSPC), so the step never shows up in all_steps() —
+            # an un-manifested partial must not shadow the last verified
+            # checkpoint nor trip a later save's StepAlreadyExists.
+            self._clear_partial(step)
             raise CheckpointWriteError(
                 f"checkpoint save at step {step} under {self._dir} failed "
                 f"{self.write_retries} attempt(s); last error: {e}"
@@ -315,6 +343,16 @@ class CheckpointManager:
             raise SimulatedPreemption(f"simulated kill mid-save at step {step}")
         self._pending = step
         return bool(queued)
+
+    def _clear_partial(self, step: int) -> None:
+        """Remove an un-manifested partial step directory (the ENOSPC
+        leftovers). A dir WITH a manifest is a real checkpoint — never
+        touched here; verified-only GC owns its lifecycle."""
+        if not self._local:
+            return
+        d = self._step_dir(step)
+        if d is not None and not os.path.exists(os.path.join(d, MANIFEST_NAME)):
+            shutil.rmtree(d, ignore_errors=True)
 
     def _corrupt_one_item(self, step: int) -> None:
         d = self._step_dir(step)

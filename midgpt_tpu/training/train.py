@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import threading
 import typing as tp
 
 import jax
@@ -38,6 +39,7 @@ from midgpt_tpu.parallel.fsdp import constrain, named_shardings
 from midgpt_tpu.parallel.mesh import batch_spec, make_mesh
 from midgpt_tpu.robustness import faults, preempt
 from midgpt_tpu.robustness.errors import DivergenceError
+from midgpt_tpu.robustness.watchdog import StepWatchdog
 from midgpt_tpu.training.checkpoint import CheckpointManager, _abstract_like
 from midgpt_tpu.training.metrics import MetricLogger, Profiler, Progress, mfu
 from midgpt_tpu.training.optim import make_optimizer, make_schedule
@@ -423,15 +425,51 @@ class TrainRuntime:
         state, self._initial = self._initial, None
         return state
 
+    def rebuild(
+        self,
+        config: ExperimentConfig,
+        *,
+        devices: tp.Optional[tp.Sequence[tp.Any]] = None,
+    ) -> "TrainRuntime":
+        """A fresh runtime on a DIFFERENT topology (elastic resume).
 
-def make_runtime(config: ExperimentConfig) -> TrainRuntime:
-    """Build the mesh/dataset/compiled-step bundle `train` runs on."""
-    mesh = make_mesh(config.mesh)
+        `devices` is the new slice (default: every visible device); the
+        mesh's data axis is re-derived for the new count and fsdp clamped
+        by make_mesh's divisor rule, so the same config resumes on whatever
+        the scheduler gives back. The dataset is shared — the positional
+        sampler is device-count-independent, which is what keeps the global
+        batch order (and so the loss trajectory) continuous across the
+        move. The step program necessarily recompiles ONCE for the new
+        mesh; the warm-then-count pin in tests/test_robustness.py holds it
+        to exactly one."""
+        return make_runtime(config, devices=devices, dataset=self.dataset)
+
+
+def make_runtime(
+    config: ExperimentConfig,
+    *,
+    devices: tp.Optional[tp.Sequence[tp.Any]] = None,
+    dataset: tp.Optional[TokenDataset] = None,
+) -> TrainRuntime:
+    """Build the mesh/dataset/compiled-step bundle `train` runs on.
+
+    `devices` pins the mesh to an explicit slice (elastic resume,
+    TrainRuntime.rebuild): the data axis is re-derived for the new count
+    (the `data=-1` inference in parallel/mesh.py, with fsdp clamped by its
+    divisor rule), so ONE config builds a valid mesh on whatever topology
+    the run lands on. `dataset` reuses an already-open TokenDataset — the
+    positional sampler is device-count-independent, which is the property
+    that keeps the global batch order continuous across a mesh change."""
+    mesh_cfg = config.mesh
+    if devices is not None:
+        mesh_cfg = dataclasses.replace(mesh_cfg, data=-1)
+    mesh = make_mesh(mesh_cfg, devices=devices)
     n_proc = jax.process_count()
     assert config.batch_size % n_proc == 0, "global batch must divide process count"
-    dataset = TokenDataset(
-        config.data_dir, seed=config.data_seed, shard_by_process=n_proc > 1
-    )
+    if dataset is None:
+        dataset = TokenDataset(
+            config.data_dir, seed=config.data_seed, shard_by_process=n_proc > 1
+        )
     params, opt_state, param_specs, optimizer = init_state(config, mesh)
     schedule = make_schedule(config)
     step, eval_loss, eval_loss_many = make_train_step(
@@ -547,6 +585,37 @@ def train(
     # to the rundir as a Chrome trace for postmortems. Host-side only —
     # spans never cross the jit boundary, so the step program is untouched.
     _tr = flight_recorder().tracer
+    # Hung-step watchdog (robustness/watchdog.py): the loop's host<->device
+    # sync points go through `_sync` so a wedged dispatch (tunnel down,
+    # device hung) is bounded by `watchdog_deadline_s` instead of blocking
+    # the process forever. Off by default: `_sync` is then a plain float()
+    # — no thread, no event, zero machinery (pinned by the watchdog-off
+    # zero-extra-programs test in tests/test_robustness.py).
+    wd = (
+        StepWatchdog(
+            config.watchdog_deadline_s,
+            escalate=config.watchdog_escalate,
+            rundir=config.rundir,
+        )
+        if config.watchdog_deadline_s > 0
+        else None
+    )
+
+    def _sync(arr, itr: int, data_itr: int) -> float:
+        # The `hang_step` fault wedges the force ITSELF (a never-set
+        # event), modeling the failure where float() never returns — so
+        # only the watchdog's worker-thread inversion can end the wait.
+        hang = faults.should_fire("hang_step", step=data_itr)
+
+        def force() -> float:
+            if hang:
+                threading.Event().wait()
+            return float(arr)
+
+        if wd is None:
+            return force()
+        return wd.sync(force, step=itr, label="train.loss_sync")
+
     try:
         for itr in range(first_step, config.max_steps):
             if itr % config.eval_interval == 0:
@@ -583,10 +652,16 @@ def train(
                 loss = jax.device_put(jnp.full((), jnp.nan, jnp.float32), replicated)
             if faults.should_fire("preempt", step=data_itr):
                 preempt.request()
+            if faults.should_fire("resume_reshard", step=data_itr):
+                # Same exit mechanics as a preemption; the DRIVER
+                # (tools/chaos_run.py) restarts on a different device count,
+                # exercising the cross-mesh resharding resume path
+                # (TrainRuntime.rebuild + on_resume_mesh in the supervisor).
+                preempt.request()
 
             tokens_since += config.batch_size * config.g_accum_iters * T
             if itr % config.log_interval == 0:
-                loss_f = float(loss)
+                loss_f = _sync(loss, itr, data_itr)
                 if not np.isfinite(loss_f):
                     # Divergence guard (no reference counterpart — its NaN
                     # runs burn wall-clock until someone looks at wandb):
@@ -658,7 +733,7 @@ def train(
             if mngr is not None and mngr.should_save(itr):
                 # One device sync per SAVE interval (not per step): never let
                 # a poisoned state overwrite the rolling checkpoints.
-                if not np.isfinite(float(loss)):
+                if not np.isfinite(_sync(loss, itr, data_itr)):
                     last_good = mngr.latest_verified_step()
                     _tr.instant(
                         "train.divergence", "train", "train",
@@ -681,10 +756,43 @@ def train(
                 # (robustness/preempt.py), so every host takes this branch
                 # at the same itr — no host-divergent control flow around
                 # the collectives inside `step`.
-                if (
+                grace = config.preempt_grace_s
+                req_at = preempt.requested_at()
+                save_late = bool(
+                    grace > 0
+                    and req_at is not None
+                    and _time.monotonic() - req_at > grace
+                )
+                if save_late:
+                    # The grace budget was spent before the save could even
+                    # START (a long step or eval sat between the signal and
+                    # this boundary): beginning a multi-second checkpoint
+                    # write now risks a SIGKILL mid-write. Skip it LOUDLY —
+                    # ledger note + flight-recorder dump below — and let
+                    # resume fall back to the last verified checkpoint.
+                    _tr.instant(
+                        "train.preempt_save_skipped", "train", "train",
+                        args={"step": itr, "grace_s": grace},
+                    )
+                    if config.rundir and not config.rundir.startswith("gs://"):
+                        from midgpt_tpu.robustness import supervisor as _sup
+
+                        _sup.append_note(
+                            config.rundir,
+                            {"event": "preempt_save_skipped", "step": itr,
+                             "grace_s": grace},
+                        )
+                    if jax.process_index() == 0:
+                        print(
+                            f"preemption: grace budget ({grace:g}s) already "
+                            f"spent at step {itr} — skipping the emergency "
+                            "save; resume falls back to the last verified "
+                            "checkpoint"
+                        )
+                elif (
                     mngr is not None
                     and mngr.latest_step() != itr  # interval save just landed?
-                    and np.isfinite(float(loss))  # never persist poisoned state
+                    and np.isfinite(_sync(loss, itr, data_itr))  # not poisoned
                 ):
                     mngr.save(itr, {"params": params, "opt_state": opt_state},
                               force=True)
@@ -699,7 +807,7 @@ def train(
                     # crash-adjacent tail as a loadable Chrome trace
                     # (docs/OBSERVABILITY.md "Crash dumps").
                     dump_flight_recorder(config.rundir)
-                if jax.process_index() == 0:
+                if jax.process_index() == 0 and not save_late:
                     print(
                         f"preemption: emergency checkpoint at step {itr} in "
                         f"{config.rundir or '(no rundir)'}; exiting"

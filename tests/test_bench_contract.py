@@ -22,6 +22,7 @@ from midgpt_tpu.analysis.bench_contract import (
     check_serve_slo_bench,
     check_serve_tp_bench,
     check_train_bench,
+    check_train_chaos,
     parse_single_json_line,
 )
 
@@ -647,3 +648,136 @@ def test_serve_slo_checker_catches_drift():
     # shed_frac outside [0, 1] is a contract violation, not a number
     assert any("outside" in p
                for p in check_serve_slo_bench(dict(good, shed_frac=1.5)))
+
+
+def test_train_chaos_checker_catches_drift():
+    """The train_chaos gates hold on a synthetic record without running
+    the chaos bench: the recovery claims (a fault FIRED, detection was
+    timestamped, the recovered trajectory matches the unfaulted reference,
+    the finishing mesh is named) are contract, not numbers."""
+    good = {
+        "tool": "chaos_run", "config": "shakespeare_char", "rundir": "/r",
+        "status": "ok", "wall_s": 10.5,
+        "faults_requested": ["resume_reshard@6"],
+        "faults_fired": {"resume_reshard": 1},
+        "supervisor": {"restarts": 0, "hung_steps": []},
+        "loss_final": 4.5, "preempted": False, "bench": "train_chaos",
+        "detected_at_ms": 5001.7, "restarts": 1,
+        "final_mesh": {"n_devices": 4, "axes": {"data": 1, "fsdp": 4}},
+        "n_devices_final": 4, "loss_ref": 4.5, "loss_parity": True,
+    }
+    assert check_train_chaos(good) == []
+    assert any("loss_parity" in p
+               for p in check_train_chaos(dict(good, loss_parity=False)))
+    missing = dict(good)
+    missing.pop("detected_at_ms")
+    assert any("detected_at_ms" in p for p in check_train_chaos(missing))
+    assert any("faults_fired" in p
+               for p in check_train_chaos(dict(good, faults_fired={})))
+    assert any("status" in p
+               for p in check_train_chaos(dict(good, status="failed")))
+    assert any("bench" in p
+               for p in check_train_chaos(dict(good, bench="train")))
+    assert any(
+        "n_devices" in p
+        for p in check_train_chaos(
+            dict(good, final_mesh={"n_devices": 0, "axes": {"data": 1}})
+        )
+    )
+    assert any(
+        "axes" in p
+        for p in check_train_chaos(
+            dict(good, final_mesh={"n_devices": 4, "axes": {}})
+        )
+    )
+    assert any("restarts" in p
+               for p in check_train_chaos(dict(good, restarts=-1)))
+
+
+@pytest.mark.slow
+def test_chaos_run_train_cli_emits_conformant_train_chaos_line(
+    capsys, tmp_path
+):
+    """`chaos_run.py --fault resume_reshard@6` (train mode) holds the
+    one-JSON-line driver contract end to end: the fault ends attempt one
+    like a preemption, the driver restarts on HALF the devices with
+    on_resume_mesh='any', the run completes on the 4-device mesh, and the
+    summary passes the train_chaos profile. Step logs and supervisor
+    prints go to stderr — stdout is the summary line, full stop."""
+    import numpy as np
+
+    from midgpt_tpu.robustness import faults, preempt
+
+    data = tmp_path / "data"
+    data.mkdir()
+    stream = (np.arange(20000) % 17).astype(np.uint16)
+    stream.tofile(data / "train.bin")
+    stream[:4000].tofile(data / "val.bin")
+
+    mod = runpy.run_path(
+        os.path.join(REPO, "tools", "chaos_run.py"), run_name="chaos_under_test"
+    )
+    argv, sys.argv = sys.argv, [
+        "chaos_run.py", "--config=shakespeare_char",
+        f"--rundir={tmp_path / 'run'}",
+        "--fault", "resume_reshard@6",
+        "--set", "max_steps=16", "--set", "eval_interval=8",
+        "--set", "eval_steps=2", "--set", "batch_size=8",
+        "--set", "log_interval=4",
+        "--set", "model_config.n_layer=1", "--set", "model_config.n_head=2",
+        "--set", "model_config.n_embd=32",
+        "--set", "model_config.block_size=32",
+        "--set", "model_config.vocab_size=96",
+        f"--set", f"data_dir={data}",
+        "--set", "mesh.data=2", "--set", "mesh.fsdp=4",
+        "--set", "param_dtype=float32", "--set", "compute_dtype=float32",
+        "--set", "restart_backoff_sec=0.0",
+    ]
+    try:
+        rc = mod["main"]()
+    finally:
+        sys.argv = argv
+        faults.clear()
+        preempt.reset()
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec, problems = check_bench_stdout(out, "train_chaos")
+    assert not problems, problems
+    assert rec["faults_fired"] == {"resume_reshard": 1}
+    # the topology actually changed hands: started on 8, finished on 4
+    assert rec["final_mesh"]["n_devices"] == 4
+    assert rec["restarts"] >= 1
+    assert rec["loss_parity"] is True
+    history = rec["supervisor"]["mesh_history"]
+    assert [m["n_devices"] for m in history] == [8, 4]
+    json.loads(out)  # strict JSON round-trip (no NaN etc.)
+
+
+def test_bench_probe_unreachable_backend_emits_error_json(
+    capsys, monkeypatch
+):
+    """bench.py with a wedged backend emits ONE machine-readable
+    {'error': 'backend_unreachable'} line within the probe budget and
+    exits nonzero — instead of hanging until the driver's timeout with
+    an empty stdout. The dead tunnel is modeled in-process via the
+    hang_step fault hook the probe honors."""
+    from midgpt_tpu.robustness import faults
+
+    monkeypatch.setenv("MIDGPT_FAULTS", "hang_step")
+    mod = runpy.run_path(
+        os.path.join(REPO, "bench.py"), run_name="bench_under_test"
+    )
+    argv, sys.argv = sys.argv, ["bench.py", "--probe-deadline", "0.3"]
+    try:
+        rc = mod["main"]()
+    finally:
+        sys.argv = argv
+        faults.clear()
+    assert rc == 1  # NOT the _run_entry_point helper: failure IS the pin
+    out = capsys.readouterr().out
+    rec, problems = parse_single_json_line(out)
+    assert not problems, problems
+    assert rec["error"] == "backend_unreachable"
+    assert rec["metric"] == "train_mfu" and rec["value"] is None
+    assert rec["detail"]["probe_deadline_s"] == 0.3
+    json.loads(out)

@@ -418,6 +418,28 @@ def test_preemption_emergency_save_and_exact_resume(
     )
 
 
+def test_preempt_grace_budget_skips_save_loudly(data_dir, runtime, tmp_path, capsys):
+    """Satellite: the grace budget was spent before the emergency save could
+    START -> the save is SKIPPED (no step-10 checkpoint), the ledger gets a
+    preempt_save_skipped note, and the flight recorder is dumped."""
+    rundir = str(tmp_path)
+    cfg = base_config(
+        data_dir, rundir=rundir, fault_plan="preempt@10", preempt_grace_s=1e-9,
+    )
+    result = supervise(cfg, runtime=runtime)
+    assert result["metrics"].get("preempted") is True
+    mngr = CheckpointManager(rundir)
+    assert mngr.latest_verified_step() == 8  # interval save only, no emergency
+    mngr.close()
+    ledger = json.load(open(os.path.join(rundir, "supervisor_state.json")))
+    assert any(
+        n.get("event") == "preempt_save_skipped" and n.get("step") == 10
+        for n in ledger.get("notes", [])
+    ), ledger
+    assert os.path.exists(os.path.join(rundir, "flight_recorder.json"))
+    assert "skipping the emergency save" in capsys.readouterr().out
+
+
 def test_sigterm_handler_sets_flag():
     """The real signal path (not the fault): SIGTERM flips the replicated
     flag; install is one-shot so a second signal would reach the previous
@@ -427,8 +449,203 @@ def test_sigterm_handler_sets_flag():
         assert not preempt.requested()
         os.kill(os.getpid(), signal.SIGTERM)
         assert preempt.requested()
+        assert preempt.requested_at() is not None  # grace clock armed
         assert preempt.any_host_requested()  # single-process: local flag
         assert signal.getsignal(signal.SIGTERM) is not preempt.request  # one-shot
     finally:
         preempt.reset()
     assert not preempt.requested()
+    assert preempt.requested_at() is None
+
+
+# ----------------------------------------------------------------------
+# elastic resume & hung-step watchdog
+# (docs/ROBUSTNESS.md "Elastic resume & watchdog")
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rt4(data_dir):
+    """The elastic-resume runtime on HALF the mesh: make_runtime re-derives
+    the data axis for 4 devices (data=1, fsdp=4). Module-scoped so the
+    cross-mesh tests pay ONE extra compile, total."""
+    return make_runtime(base_config(data_dir), devices=jax.devices()[:4])
+
+
+def test_make_runtime_rederives_data_axis(rt4):
+    shape = dict(rt4.mesh.shape)
+    assert shape["data"] == 1 and shape["fsdp"] == 4
+    assert len(rt4.mesh.devices.flatten()) == 4
+
+
+def test_cross_mesh_reshard_resume_8_4_8(
+    data_dir, runtime, rt4, straight16, tmp_path
+):
+    """Tentpole acceptance: train on 8 devices, checkpoint, resume on 4,
+    checkpoint again, resume back on 8 — the loss trajectory matches the
+    uninterrupted run (rtol covers only the f32 reassociation of the
+    re-derived data-axis all-reduce; the batch order is positional and
+    exact), the ledger records every mesh the run touched, and each mesh
+    compiled exactly ONE step program, ever (warm-then-count: the module
+    fixtures are the warm, the jit cache sizes are the count)."""
+    straight, straight_dir = straight16
+    rundir = str(tmp_path)
+    # phase 1 on 8 devices: the reshard fault ends the attempt like a
+    # preemption at step 5 (emergency save verified)
+    faults.activate("resume_reshard", step=5)
+    r1 = supervise(base_config(data_dir, rundir=rundir), runtime=runtime)
+    assert r1["metrics"].get("preempted") is True
+    # phase 2 on 4 devices: the 8-device checkpoint restores through the
+    # NEW mesh's shardings (on_resume_mesh="any"); preempted again at 10
+    preempt.reset()
+    faults.clear()
+    faults.activate("preempt", step=10)
+    r2 = supervise(
+        base_config(data_dir, rundir=rundir, on_resume_mesh="any"), runtime=rt4
+    )
+    assert r2["metrics"].get("preempted") is True
+    assert [m["n_devices"] for m in r2["supervisor"]["mesh_history"]] == [8, 4]
+    # phase 3 back on 8 devices: the 4-device checkpoint reshards UP again
+    preempt.reset()
+    faults.clear()
+    r3 = supervise(
+        base_config(data_dir, rundir=rundir, on_resume_mesh="any"),
+        runtime=runtime,
+    )
+    assert [m["n_devices"] for m in r3["supervisor"]["mesh_history"]] == [8, 4, 8]
+    # trajectory parity across BOTH mesh moves
+    a, b = _logged_losses(straight_dir), _logged_losses(rundir)
+    overlap = sorted(set(a) & set(b))
+    assert len(overlap) >= 15, (sorted(a), sorted(b))
+    np.testing.assert_allclose(
+        [a[s] for s in overlap], [b[s] for s in overlap], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        r3["metrics"]["loss/final"], straight["metrics"]["loss/final"],
+        rtol=1e-6,
+    )
+    # one program per mesh: neither resume recompiled the other's step
+    assert jit_cache_size(runtime.step) == 1
+    assert jit_cache_size(rt4.step) == 1
+
+
+def test_on_resume_mesh_same_refuses_topology_change(data_dir, runtime, tmp_path):
+    """Default policy: a resume that sees a different device count than the
+    ledger recorded fails loudly BEFORE training starts."""
+    from midgpt_tpu.robustness import supervisor as sup_mod
+
+    sup_mod._save_state(
+        str(tmp_path),
+        {"mesh": {"n_devices": 4, "axes": {"data": 1, "fsdp": 4, "sp": 1}}},
+    )
+    with pytest.raises(RuntimeError, match="on_resume_mesh"):
+        supervise(base_config(data_dir, rundir=str(tmp_path)), runtime=runtime)
+
+
+def test_supervisor_hang_step_restart_completes(
+    data_dir, runtime, straight16, tmp_path
+):
+    """Watchdog acceptance: hang_step@12 wedges the step's device sync; the
+    0.3s watchdog ends the wait, dumps the postmortem artifacts, the
+    supervisor marks the step HUNG (data offset UNTOUCHED — a hang is not a
+    data problem) and the restart completes with exact-continuation
+    parity, all on the one compiled step program."""
+    straight, _ = straight16
+    rundir = str(tmp_path)
+    cfg = base_config(
+        data_dir, rundir=rundir, fault_plan="hang_step@12",
+        watchdog_deadline_s=0.3,
+    )
+    result = supervise(cfg, runtime=runtime)
+    sup = result["supervisor"]
+    assert sup["hung_steps"] == [12] and sup["restarts"] == 1
+    assert sup["faults_fired"] == {"hang_step": 1}
+    assert sup["data_step_offset"] == 0
+    assert os.path.exists(os.path.join(rundir, "flight_recorder.json"))
+    assert os.path.exists(os.path.join(rundir, "flight_recorder.prom"))
+    ledger = json.load(open(os.path.join(rundir, "supervisor_state.json")))
+    assert ledger["hung_steps"] == [12]
+    np.testing.assert_allclose(
+        result["metrics"]["loss/final"], straight["metrics"]["loss/final"],
+        rtol=1e-6,
+    )
+    assert jit_cache_size(runtime.step) == 1
+
+
+def test_watchdog_armed_is_invisible(data_dir, runtime, straight16, tmp_path):
+    """An armed-but-never-expiring watchdog changes NOTHING: bit-identical
+    logged losses vs the straight run (same runtime, deterministic step)
+    and zero extra XLA programs — the guard is pure host machinery."""
+    straight, straight_dir = straight16
+    rundir = str(tmp_path)
+    train(
+        base_config(data_dir, rundir=rundir, watchdog_deadline_s=60.0),
+        runtime=runtime,
+    )
+    a, b = _logged_losses(straight_dir), _logged_losses(rundir)
+    assert sorted(a) == sorted(b)
+    np.testing.assert_array_equal(
+        [a[s] for s in sorted(a)], [b[s] for s in sorted(b)]
+    )
+    assert jit_cache_size(runtime.step) == 1
+
+
+def test_ckpt_enospc_retry_recovers(tmp_path):
+    """Degraded IO: ENOSPC with partial bytes left mid-write, twice — the
+    retry sweeps the partial and the third attempt lands verified."""
+    faults.activate("ckpt_enospc", times=2)
+    mngr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, write_retries=3,
+        retry_backoff_sec=0.0,
+    )
+    assert mngr.save(0, _np_state()) is True
+    mngr.wait()
+    assert faults.fired_counts()["ckpt_enospc"] == 2
+    assert mngr.is_verified(0) and not mngr.verify(0)
+    mngr.close()
+
+
+def test_ckpt_enospc_budget_exhaustion_leaves_no_partial(tmp_path):
+    """Acceptance: ENOSPC through the whole retry budget — the save fails
+    loudly, NO partial step is left on disk or visible to
+    latest_verified_step, and the earlier verified checkpoint survives
+    (verified-only GC never touched it)."""
+    mngr = CheckpointManager(
+        str(tmp_path), save_interval_steps=1, write_retries=2,
+        retry_backoff_sec=0.0,
+    )
+    state = _np_state()
+    mngr.save(1, state)
+    mngr.wait()
+    assert mngr.latest_verified_step() == 1
+    faults.activate("ckpt_enospc", times=5)
+    with pytest.raises(CheckpointWriteError, match="2 attempt"):
+        mngr.save(2, _np_state(seed=1))
+    # the partial step-2 bytes were swept on the failure path
+    assert not os.path.exists(os.path.join(str(tmp_path), "2"))
+    assert mngr.latest_verified_step() == 1
+    restored = mngr.restore(1, _like(state))
+    np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+    mngr.close()
+
+
+def test_corrupt_supervisor_state_quarantined(tmp_path, capsys):
+    """Satellite regression: a torn/garbage ledger is quarantined to
+    `.corrupt` with a warning and a fresh ledger takes over — a damaged
+    sidecar must never brick a resume whose checkpoints are intact."""
+    from midgpt_tpu.robustness import supervisor as sup_mod
+
+    path = os.path.join(str(tmp_path), "supervisor_state.json")
+    with open(path, "w") as fh:
+        fh.write('{"data_step_offset": 3, "windo')  # torn mid-write
+    assert sup_mod._load_state(str(tmp_path)) == {}
+    assert os.path.exists(path + ".corrupt") and not os.path.exists(path)
+    assert "quarantined" in capsys.readouterr().out
+    # the fresh ledger works on top of the quarantine
+    sup_mod.append_note(str(tmp_path), {"event": "x"})
+    assert sup_mod._load_state(str(tmp_path))["notes"] == [{"event": "x"}]
+    # non-object JSON is corrupt too (the ledger is always a dict)
+    with open(path, "w") as fh:
+        fh.write("[1, 2]")
+    assert sup_mod._load_state(str(tmp_path)) == {}
+    assert "quarantined" in capsys.readouterr().out

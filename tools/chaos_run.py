@@ -35,7 +35,22 @@ a token, with page conservation extended across replicas and tiers:
     python tools/chaos_run.py --serve --fault handoff_stall
     python tools/chaos_run.py --serve --fault spill_corrupt
 
-`--list-faults` prints the registered kinds with one-line descriptions;
+Degraded-IO / elastic-topology gates (docs/ROBUSTNESS.md "Elastic resume
+& watchdog") — these train-mode kinds emit the `train_chaos` bench-contract
+profile (detected_at_ms, restarts, final_mesh, loss_parity vs an unfaulted
+reference run) on the summary line:
+
+    python tools/chaos_run.py --config=... --rundir=... \
+        --fault hang_step@12 --set watchdog_deadline_s=2
+    python tools/chaos_run.py --config=... --rundir=... --fault ckpt_enospc*2
+    python tools/chaos_run.py --config=... --rundir=... --fault resume_reshard@6
+
+(`resume_reshard` ends the first attempt like a preemption; the driver then
+restarts on HALF the visible devices with on_resume_mesh="any", exercising
+the cross-mesh checkpoint resharding resume, and runs to completion.)
+
+`--list-faults` prints the registered kinds — training, serving, and fleet
+in one table — with one-line descriptions;
 unknown `--fault` kinds fail up front with that same list.
 
 With `--rundir`, serving mode records the fault pass under a flight
@@ -192,6 +207,7 @@ def main() -> int:
     from midgpt_tpu.config import load_config
     from midgpt_tpu.robustness import faults, preempt
     from midgpt_tpu.robustness.supervisor import supervise
+    from midgpt_tpu.training.train import make_runtime
 
     launch_mod = _load_launch()
     config = load_config(args.config)
@@ -205,13 +221,63 @@ def main() -> int:
     if args.max_restarts is not None:
         config = config.replace(max_restarts=args.max_restarts)
 
+    # Degraded-IO / elastic-topology gates (`train_chaos` bench-contract
+    # profile): when one of these kinds is requested, the summary grows
+    # detection latency, driver-level restart counts, the final mesh, and a
+    # loss-parity verdict against an unfaulted reference run.
+    TRAIN_CHAOS_KINDS = {"hang_step", "ckpt_enospc", "resume_reshard"}
+    requested_kinds = {
+        (faults._PLAN_RE.match(s.strip()).group("kind")) for s in args.fault
+    }
+    train_chaos = bool(requested_kinds & TRAIN_CHAOS_KINDS)
+
     preempt.install_handlers()
     t0 = time.time()
+    # Detection latency: the registry's firing observer timestamps each
+    # kind's FIRST firing (the wall clock stays here in tools/, keeping
+    # robustness/ clock-free per the GC012 discipline).
+    fire_ms: dict = {}
+    faults.set_on_fire(
+        lambda f: fire_ms.setdefault(f.kind, round((time.time() - t0) * 1000.0, 1))
+    )
     status = "ok"
     error = None
     result = None
+    # train_chaos drives the runtime explicitly so the driver can (a) report
+    # the final mesh and (b) reuse the compiled step for the parity
+    # reference run; plain chaos keeps the historical supervise-owned path.
+    rt = make_runtime(config) if train_chaos else None
+    reshard_restarts = 0
+    # The summary line below is the ONLY stdout this tool may produce (the
+    # one-JSON-line driver contract); the supervised run's step logs and
+    # supervisor prints go to stderr, where operators still see them.
+    import contextlib
+
+    _to_stderr = contextlib.redirect_stdout(sys.stderr)
     try:
-        result = supervise(config)
+        with _to_stderr:
+            result = supervise(config, runtime=rt)
+            # resume_reshard ends the attempt like a preemption; the driver
+            # then plays the scheduler: restart on HALF the devices with
+            # on_resume_mesh="any" (the cross-mesh resharding resume) and
+            # run to completion. Fault re-injection is NOT replayed on
+            # restart — the registry keeps the consumed firing, like a real
+            # one-shot failure.
+            while (
+                result is not None
+                and result["metrics"].get("preempted")
+                and "resume_reshard" in fire_ms
+                and reshard_restarts < 4
+            ):
+                preempt.reset()
+                preempt.install_handlers()
+                devs = list(jax.devices())
+                n_new = len(devs) // 2 if reshard_restarts % 2 == 0 else len(devs)
+                n_new = max(1, n_new)
+                cfg2 = config.replace(on_resume_mesh="any", fault_plan="")
+                rt = rt.rebuild(cfg2, devices=devs[:n_new])
+                reshard_restarts += 1
+                result = supervise(cfg2, runtime=rt)
     except (RuntimeError, FloatingPointError) as e:
         # Budget exhaustion / unrecoverable divergence: that outcome IS the
         # chaos result — report it as data, nonzero exit.
@@ -232,6 +298,47 @@ def main() -> int:
         }
         summary["loss_final"] = result["metrics"].get("loss/final")
         summary["preempted"] = bool(result["metrics"].get("preempted", False))
+    if train_chaos:
+        import numpy as np
+
+        summary["bench"] = "train_chaos"
+        fired_ms = [fire_ms[k] for k in TRAIN_CHAOS_KINDS if k in fire_ms]
+        summary["detected_at_ms"] = min(fired_ms) if fired_ms else None
+        summary["restarts"] = (
+            int(result["supervisor"]["restarts"]) if result is not None else 0
+        ) + reshard_restarts
+        if rt is not None:
+            summary["final_mesh"] = {
+                "n_devices": int(len(rt.mesh.devices.flatten())),
+                "axes": {k: int(v) for k, v in rt.mesh.shape.items()},
+            }
+            summary["n_devices_final"] = summary["final_mesh"]["n_devices"]
+        loss_parity = False
+        if status == "ok" and result is not None and summary["loss_final"] is not None:
+            # Parity verdict: an UNFAULTED run of the same config (fresh
+            # rundir, empty registry) on the final runtime — shares the
+            # compiled step, so this costs steps, not compiles. rtol covers
+            # the f32 reassociation of a re-derived data-axis all-reduce
+            # after a mesh change (~1e-8 measured); the batch order itself
+            # is positional and exact.
+            faults.clear()
+            preempt.reset()
+            cfg_ref = config.replace(
+                rundir=config.rundir + "_ref", fault_plan="",
+                on_resume_mesh="any",
+            )
+            with contextlib.redirect_stdout(sys.stderr):
+                ref = supervise(cfg_ref, runtime=rt)
+            ref_loss = ref["metrics"].get("loss/final")
+            summary["loss_ref"] = ref_loss
+            loss_parity = bool(
+                ref_loss is not None
+                and np.isfinite(summary["loss_final"])
+                and np.allclose(
+                    summary["loss_final"], ref_loss, rtol=1e-5, atol=1e-6
+                )
+            )
+        summary["loss_parity"] = loss_parity
     if error is not None:
         summary["error"] = error
     print(json.dumps(summary))
