@@ -79,10 +79,16 @@ def check_train_bench(rec: dict) -> tp.List[str]:
 
 
 def _require_round_decomp(rec: dict, problems: tp.List[str]) -> None:
-    """round_host_ms / round_device_ms: the decode-round split the flight
-    recorder measures (docs/OBSERVABILITY.md). Each is {p50, p95} in ms,
-    finite (NaN already rejected at parse) and non-negative."""
-    for key in ("round_host_ms", "round_device_ms"):
+    """round_host_ms / round_device_ms / overlap_hidden_ms: the decode-round
+    split the flight recorder measures (docs/OBSERVABILITY.md). Each is
+    {p50, p95} in ms, finite (NaN already rejected at parse) and
+    non-negative. Round-overlap dispatch (docs/SERVING.md) rides the same
+    records: `overlap_mode` names the dispatch mode, `round_group` the
+    fused rounds per dispatch (1 unless mode is 'group'), and
+    `overlap_hidden_ms` the host time hidden under in-flight dispatches —
+    an honest zero when overlap is off, which is why the fields are
+    required rather than optional: their absence is a silent A/B lie."""
+    for key in ("round_host_ms", "round_device_ms", "overlap_hidden_ms"):
         d = rec.get(key)
         if not isinstance(d, dict):
             problems.append(f"field {key!r} must be an object with p50/p95")
@@ -93,6 +99,17 @@ def _require_round_decomp(rec: dict, problems: tp.List[str]) -> None:
                 problems.append(f"field {key!r}.{q} must be a number")
             elif v < 0:
                 problems.append(f"{key}.{q} {v} < 0")
+    mode = rec.get("overlap_mode")
+    if mode not in ("off", "double", "group"):
+        problems.append(
+            f"field 'overlap_mode' is {mode!r}, expected off/double/group"
+        )
+    rg = rec.get("round_group")
+    if not isinstance(rg, int) or isinstance(rg, bool) or rg < 1:
+        problems.append(f"field 'round_group' must be an int >= 1, got {rg!r}")
+    elif mode != "group" and rg != 1:
+        problems.append(f"round_group {rg} with overlap_mode {mode!r} — "
+                        "groups only exist in 'group' mode")
 
 
 def check_serve_bench(rec: dict) -> tp.List[str]:

@@ -93,6 +93,31 @@ SPLIT_ZERO_COPY_KEYS: tp.Tuple[str, ...] = (
 # holds {body: n_collectives} and every value must be 0.
 SPLIT_ZERO_COLLECTIVE_KEYS: tp.Tuple[str, ...] = ("split_decode_while_bodies",)
 
+# Round-overlap dispatch (docs/SERVING.md "Round-overlap dispatch"): the
+# fused multi-round group program (`_serve_decode_group`) wraps k decode
+# rounds in one lax.scan, so its while body carries the ENTIRE pool through
+# the scan carry. The aliasing pin must hold at every audited round_group —
+# a single in-loop pool copy would multiply by k rounds per dispatch and
+# erase the overlap win. `run_audit` lowers the group program at these
+# round_group values (f32 at both, int8 at the first).
+ROUND_GROUPS_AUDITED: tp.Tuple[int, ...] = (2, 4)
+
+# All-zero copy census keys for the group lowerings (same dict-per-body
+# form as the split-K keys above: every value must be 0).
+GROUP_ZERO_COPY_KEYS: tp.Tuple[str, ...] = (
+    "group2_decode_loop_pool_copies",
+    "group4_decode_loop_pool_copies",
+    "group2_decode_int8_loop_pool_copies",
+    "group2_decode_int8_loop_scale_copies",
+)
+
+# The group scan body is single-engine work — zero collectives of any kind
+# may appear in it ({body: n_collectives}, every value 0).
+GROUP_ZERO_COLLECTIVE_KEYS: tp.Tuple[str, ...] = (
+    "group2_decode_while_bodies",
+    "group4_decode_while_bodies",
+)
+
 
 def tp_loop_all_reduce_budget(
     program: str, geom: AuditGeometry = AUDIT

@@ -551,6 +551,97 @@ def run_audit() -> tp.Dict[str, tp.Any]:
         )
 
     # ------------------------------------------------------------------
+    # fused multi-round group lowerings: k rounds, one pool carry
+    # ------------------------------------------------------------------
+    # Round-overlap dispatch's group lever (sampling/serve.py
+    # _serve_decode_group; docs/SERVING.md "Round-overlap dispatch") wraps
+    # round_group decode rounds in one lax.scan, so a single in-loop pool
+    # copy would be paid n_steps * round_group times PER DISPATCH — the
+    # census that caught the r1-r4 structure (RESULTS.md §1) matters k
+    # times more here. Lowered at every budgets.ROUND_GROUPS_AUDITED value
+    # (f32) plus int8 at the smallest; the scan body is single-engine work
+    # and must carry zero collectives of any kind.
+    from midgpt_tpu.sampling.serve import _serve_decode_group
+
+    for rg in budgets.ROUND_GROUPS_AUDITED:
+        group_hlo = (
+            _serve_decode_group.lower(
+                mc,
+                params_abs,
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                cache_abs,
+                jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.bool_),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.bool_),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                g.decode_chunk,
+                rg,
+                0.0,
+                None,
+                None,
+                "gather",
+                None,
+            )
+            .compile()
+            .as_text()
+        )
+        assert_no_while_body_collectives(group_hlo, ops=COLLECTIVE_OPS)
+        g_census = while_body_collectives(group_hlo)
+        report[f"group{rg}_decode_while_bodies"] = {
+            b: len(ls) for b, ls in g_census.items()
+        }
+        assert g_census, f"group:{rg} decode lowered without its scan loop"
+        g_copies = while_body_pool_copies(group_hlo, pool_shape)
+        report[f"group{rg}_decode_loop_pool_copies"] = {
+            b: len(ls) for b, ls in g_copies.items()
+        }
+        assert all(not ls for ls in g_copies.values()), (
+            f"pool-sized copies inside the group:{rg} decode scan body: "
+            + str({b: ls[:1] for b, ls in g_copies.items() if ls})
+        )
+
+    rg0 = budgets.ROUND_GROUPS_AUDITED[0]
+    group8_hlo = (
+        _serve_decode_group.lower(
+            mc,
+            params_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache8_abs,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            g.decode_chunk,
+            rg0,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        )
+        .compile()
+        .as_text()
+    )
+    assert_no_while_body_collectives(group8_hlo, ops=COLLECTIVE_OPS)
+    for label, shape in (("pool", pool8_shape), ("scale", scale_shape)):
+        copies = while_body_pool_copies(group8_hlo, shape)
+        report[f"group{rg0}_decode_int8_loop_{label}_copies"] = {
+            b: len(ls) for b, ls in copies.items()
+        }
+        assert all(not ls for ls in copies.values()), (
+            f"{label}-sized copies inside the group:{rg0} int8 scan body: "
+            + str({b: ls[:1] for b, ls in copies.items() if ls})
+        )
+
+    # ------------------------------------------------------------------
     # tp serving mesh: per-program in-loop collective census
     # ------------------------------------------------------------------
     # The mesh-sharded engine's perf claim (docs/SERVING.md "Mesh-sharded

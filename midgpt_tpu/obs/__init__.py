@@ -21,7 +21,13 @@ the engine loop reads its injected clock at four boundaries per round —
 `t_host_post` = t_post-t_land. These aggregate to p50/p95 in histograms
 and surface on `stats()["obs"]["round_decomp"]`, loadgen's serve_slo
 points, and the bench_serve profiles — the baseline artifact ROADMAP
-item 5 (round-overlap dispatch) will A/B against.
+item 3's round-overlap dispatch A/Bs against. Under overlap="double"
+(sampling/serve.py `_step_overlapped`) round N settles one step late, so
+its t1 -> t_land window CONTAINS host work for other rounds; the engine
+reports that overlapped span via `hidden_s` and it surfaces as the
+`overlap_hidden` decomposition entry (`overlap_hidden_ms` on the bench
+lines) — the host time the overlap actually hid, the A/B headline of
+docs/SERVING.md "Round-overlap dispatch".
 
 The module-level `flight_recorder()` singleton is the always-on crash
 recorder for the training path: train/checkpoint/supervisor record into
@@ -79,6 +85,10 @@ class Observability:
         self._h_post = self.metrics.histogram(
             "round_host_post_s", "token commit + trie bookkeeping per round"
         )
+        self._h_hidden = self.metrics.histogram(
+            "round_overlap_hidden_s", "host work overlapped under an "
+            "in-flight dispatch (round-overlap dispatch; 0 when off)"
+        )
         self._rounds = self.metrics.counter(
             "rounds_decomposed", "rounds with timing decomposition recorded"
         )
@@ -88,14 +98,20 @@ class Observability:
     def record_round(
         self, kind: str, tid: str,
         t0: float, t1: float, t_land: float, t_post: float,
+        hidden_s: float = 0.0,
     ) -> None:
         """Record one engine round's boundary clock readings (see module
         docstring for the four-boundary semantics). Also emits the three
         phase spans into the flight recorder with explicit timestamps —
-        no extra clock reads beyond the four the engine already took."""
+        no extra clock reads beyond the four the engine already took.
+        `hidden_s` is the slice of t1 -> t_land spent doing OTHER rounds'
+        host work under round-overlap dispatch (the engine reads the clock
+        once more as the settle force starts); it defaults to 0.0 so
+        classic rounds record an honest zero."""
         self._h_dispatch.observe(t1 - t0)
         self._h_device.observe(t_land - t1)
         self._h_post.observe(t_post - t_land)
+        self._h_hidden.observe(hidden_s)
         self._rounds.inc()
         self.tracer.complete(f"{kind}.dispatch", "round", tid, t0, t1 - t0)
         self.tracer.complete(
@@ -122,6 +138,7 @@ class Observability:
             "dispatch": _ms(self._h_dispatch),
             "device_wait": _ms(self._h_device),
             "host_post": _ms(self._h_post),
+            "overlap_hidden": _ms(self._h_hidden),
         }
 
     # -- unified stats schema -------------------------------------------

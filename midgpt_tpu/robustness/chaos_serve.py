@@ -18,7 +18,13 @@ gate (tests/test_chaos_serve.py, `chaos_run.py --serve`) enforces:
      engine-reported: `poisoned_uids` for poisoned_page, non-"ok" statuses
      for sheds/timeouts/cancels. kill_mid_decode affects NOBODY — its
      recovery is recompute preemption, which is parity-preserving — so
-     there every request must match.
+     there every request must match. kill_overlapped_round is its
+     round-overlap twin (docs/SERVING.md "Round-overlap dispatch"): the
+     engine runs with `overlap="double"`, the fault drops the IN-FLIGHT
+     dispatched round's handle un-settled mid host phase, and the same
+     recompute-preemption path must regenerate every lost token — the
+     reference pass stays un-overlapped, so the parity check also re-proves
+     that overlap itself is bit-exact.
 
 Two model-ops scenarios ride the same harness (sampling/ops.py,
 docs/ROBUSTNESS.md "Zero-downtime model ops") with a THREE-sided parity
@@ -124,7 +130,7 @@ def _trace(cfg, seed: int, n_requests: int, shared: bool = False):
 
 def _engine(
     cfg, params, *, max_backlog_pages=None, clock=None, prefix=False,
-    obs=None, cache_dtype=None,
+    obs=None, cache_dtype=None, overlap="off",
 ):
     import jax.numpy as jnp
 
@@ -151,6 +157,7 @@ def _engine(
         cache_dtype=jnp.float32 if cache_dtype is None else cache_dtype,
         max_backlog_pages=max_backlog_pages,
         prefix_cache=prefix,
+        overlap=overlap,
         **kw,
     )
 
@@ -336,6 +343,10 @@ def run_serving_chaos(
     # cache ON over a template-shared trace, so the reference pass also
     # proves the cache itself is parity-clean before the flush is judged.
     uses_prefix = "evict_shared_prefix" in fault_plan
+    # The overlap-kill fault needs an in-flight dispatched round to drop:
+    # only the fault pass runs double-buffered — the reference stays plain,
+    # so invariant 3 doubles as an overlap-on-vs-off greedy parity check.
+    uses_overlap = "kill_overlapped_round" in fault_plan
     trace = _trace(cfg, seed + 1, n_requests, shared=uses_prefix)
 
     ref_tokens = _reference_pass(cfg, params, trace, prefix=uses_prefix)
@@ -343,6 +354,7 @@ def run_serving_chaos(
         cfg, params, fault_plan,
         max_backlog_pages=STORM_BACKLOG_PAGES if uses_storm else None,
         prefix=uses_prefix,
+        overlap="double" if uses_overlap else "off",
     )
 
     def body() -> tp.Dict[str, tp.Any]:
@@ -386,6 +398,10 @@ def run_serving_chaos(
             f"request(s)"
         )
         assert sum(fired.values()) >= min(1, len(armed)), "no armed fault fired"
+        if fired.get("kill_overlapped_round"):
+            assert eng.overlap_kills >= 1, (
+                "overlap kill fired but no in-flight round was ever dropped"
+            )
 
         return {
             "mode": "serve",
@@ -393,6 +409,8 @@ def run_serving_chaos(
             "faults_fired": fired,
             "n_requests": n_requests,
             "statuses": statuses,
+            "overlap_mode": eng.overlap,
+            "overlap_kills": eng.overlap_kills,
             "shed": eng.shed + storm_shed,
             "timeouts": eng.timeouts,
             "cancelled": eng.cancelled,

@@ -54,6 +54,14 @@ firing deterministic):
                      decode-ready slot is recompute-preempted and the
                      token streams must come out identical to an
                      unfaulted run.
+  kill_overlapped_round  the IN-FLIGHT round N+1 dispatch dies while round
+                     N's host work runs (overlap="double" engines keep two
+                     rounds in the pipe — sampling/serve.py
+                     `_step_overlapped`): the unsettled handle is dropped
+                     without forcing, its slots recompute-preempt, the
+                     watchdog still bounds a hung settle, and bystander
+                     streams plus the page pool must come through
+                     bit-identical / conserved (chaos_serve.py gate).
   poisoned_page      corrupt one live slot's first pool page in place
                      (HBM damage); page isolation must keep every OTHER
                      slot's stream bit-identical while the engine keeps
@@ -133,6 +141,7 @@ KINDS = (
     "resume_reshard",
     # serving (sampling/serve.py, sampling/server.py, chaos_serve.py)
     "kill_mid_decode",
+    "kill_overlapped_round",
     "poisoned_page",
     "slow_client",
     "submit_storm",
@@ -158,6 +167,7 @@ DESCRIPTIONS: tp.Dict[str, str] = {
     "ckpt_enospc": "ENOSPC mid checkpoint write, partial bytes left behind",
     "resume_reshard": "preempt at data step k; driver restarts on another mesh",
     "kill_mid_decode": "the round's decode dispatch dies; slots recompute-preempt",
+    "kill_overlapped_round": "the in-flight overlapped dispatch dies mid host phase",
     "poisoned_page": "corrupt one live slot's pool page in place (HBM damage)",
     "slow_client": "a streaming client stops draining; bounded buffer sheds it",
     "submit_storm": "submission burst beyond the backpressure budget; excess sheds",

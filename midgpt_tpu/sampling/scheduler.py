@@ -34,6 +34,21 @@ only ever offers preemption candidates strictly younger (later
 request always makes progress no matter what a policy returns. A policy
 returning a non-candidate is a contract violation and raises.
 
+**Deferred-effect semantics under round-overlap dispatch** (sampling/
+serve.py `_step_overlapped`, docs/SERVING.md "Round-overlap dispatch"):
+policy decisions are HOST decisions and only ever take effect at the next
+dispatch boundary, never mid-flight. With overlap off that boundary is the
+same round; with overlap="double" the engine dispatches round N+1 BEFORE
+running round N's host phase, so a request this policy admits (or a victim
+it selects) during round N's host phase first appears in (disappears from)
+round N+2's dispatched batch — the one-round-late boundary the engine's
+`dispatch_log` records and tests/test_overlap.py pins for both shipped
+policies. Policies need no awareness of this: the interface below is
+unchanged, the engine alone decides when a decision lands on the device,
+and an eviction of a slot with an in-flight dispatch simply discards that
+slot's un-settled tokens (recompute preemption regenerates them
+bit-exactly).
+
 With the cross-request prefix cache on (sampling/prefix_cache.py), the
 backpressure accounting policies see is refcount-aware: the engine's
 `_backlog_pages` charges a trie-shared page ONCE no matter how many

@@ -39,6 +39,20 @@ buckets [spec_k_min, spec_k_max]; rejected tail positions roll back
 page-aligned (length counters reset, tail pages freed, device pool never
 rewritten).
 
+Round-overlap dispatch (docs/SERVING.md "Round-overlap dispatch") hides
+the per-dispatch tunnel latency behind two composable levers, both off by
+default and both compiled from the SAME `_serve_decode_group` program:
+`overlap="group"` fuses `round_group` decode rounds into one dispatched
+`lax.scan` (EOS / budget / page-boundary handling masks on device, so a
+slot that finishes mid-group settles at the group edge exactly where a
+sequence of classic rounds would), and `overlap="double"` additionally
+dispatches round N+1 BEFORE round N's host post-processing runs
+(`_step_overlapped`), chaining device-side token/length state between the
+two in-flight programs. Scheduler decisions are one round late by
+construction under "double" — an admission or eviction during round N's
+host phase first appears in round N+2's dispatch — and greedy streams
+stay bit-exact across every mode (tests/test_overlap.py).
+
 When the pool runs dry, the scheduler EVICTS a younger running slot
 (frees its pages, pushes the request back to the queue front with its
 generated tokens folded into the prompt — recompute-style preemption), so
@@ -106,6 +120,7 @@ slot batch) and is only distributionally equivalent.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import time
@@ -196,6 +211,135 @@ def _serve_decode_chunk(
         body, (token, cache, lengths, key), None, length=n_steps
     )
     return cache, toks
+
+
+# Cap on the fused multi-round group size (docs/SERVING.md "Round-overlap
+# dispatch"): k rounds per dispatched program trade scheduling granularity
+# (admissions/evictions only land at group edges) for dispatch amortization,
+# and past ~8 the granularity cost dominates on any realistic trace.
+_ROUND_GROUP_CAP = 8
+
+
+def _round_group_bucket(group: int) -> int:
+    """Clamp a requested multi-round group size to [1, _ROUND_GROUP_CAP]
+    and floor it to a power of two — the same pow2 ladder every other
+    static jit knob (decode chunk, page bucket, split_k) rides, so the
+    compile set stays logarithmic and the GC011 static-domain prover can
+    see the bound lexically."""
+    group = max(1, min(int(group), _ROUND_GROUP_CAP))
+    return 1 << (group.bit_length() - 1)
+
+
+def parse_overlap(spec: str) -> tp.Tuple[str, int]:
+    """Parse the `--overlap {off,double,group:k}` CLI form shared by
+    tools/bench_serve.py and tools/loadgen.py into the engine's
+    (overlap, round_group) kwargs. Strict: anything else raises, so a
+    typo'd A/B flag fails the bench instead of silently measuring 'off'."""
+    if spec in ("off", "double"):
+        return spec, 1
+    if spec.startswith("group:"):
+        try:
+            k = int(spec.split(":", 1)[1])
+        except ValueError:
+            k = 0
+        if k >= 1:
+            return "group", k
+    raise ValueError(
+        f"bad overlap spec {spec!r} (want 'off', 'double', or 'group:k' "
+        "with k >= 1)"
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0, 12, 13, 14, 15, 16, 17, 19, 20),
+    donate_argnums=(3,),
+)
+def _serve_decode_group(
+    config,
+    params,
+    token,  # (B,) int32 — host view of each slot's pending token
+    cache,  # PagedKVCache (donated)
+    page_table,  # (B, max_pages) int32
+    lengths,  # (B,) int32 — host view of committed lengths
+    active,  # (B,) bool — batch membership at dispatch
+    eos,  # (B,) int32 — per-slot EOS id, -1 when the request has none
+    max_len,  # (B,) int32 — absolute settle bound per slot (see below)
+    chain_mask,  # (B,) bool — slots continuing from an unsettled group
+    chain_token,  # (B,) int32 — device-side pending token for chained slots
+    chain_len,  # (B,) int32 — device-side lengths for chained slots
+    n_steps: int,
+    round_group: int,
+    temperature: float,
+    top_k,
+    top_p,
+    attn_impl: str,
+    key=None,
+    mesh=None,  # static (Mesh hashes) — tp serving mesh, None = single chip
+    split_k: int = 1,  # static — key partitions per slot (docs/SERVING.md)
+):
+    """`n_steps * round_group` decode+sample steps as ONE dispatched
+    program — the fused multi-round group of the round-overlap scheme
+    (docs/SERVING.md "Round-overlap dispatch"). Differences from
+    `_serve_decode_chunk`, all serving the settle-at-the-boundary rule:
+
+      * **Device-side finish masking.** A slot stops stepping the moment
+        its length reaches `max_len` (its generation budget or provisioned
+        pages, whichever binds first) or it emits its EOS token —
+        `step_active` masks the K/V write, the emit, and the length
+        advance, so a finished slot can NEVER write past the pages it was
+        provisioned at dispatch (an out-of-range page-table gather clamps
+        to a REAL page, so an unmasked overrun would corrupt a neighbor's
+        — or the trie's — committed K/V). The emitted mask is returned so
+        the host commits exactly the tokens a sequence of classic rounds
+        would have.
+      * **Chained carry-in.** Under double-buffering the previous group is
+        still in flight at dispatch: the host's token/length view of its
+        slots is one round stale, so the true values ride in on
+        `chain_token`/`chain_len` (the previous program's outputs, never
+        forced) and are merged under `chain_mask` INSIDE this program —
+        one dispatch per round, no eager merge ops through the tunnel.
+
+    `round_group` is a pow2-bucketed static (`_round_group_bucket`), so
+    the compile set stays one program per (n_steps bucket, page bucket,
+    round_group) — pinned by tests/test_recompile_pins.py. Returns
+    (cache, toks (T, B), emitted (T, B) bool, tok_fin (B,), len_fin (B,))
+    with T = n_steps * round_group; tok_fin/len_fin seed the next group's
+    chain without settling this one."""
+    token = jnp.where(chain_mask, chain_token, token)
+    lengths = jnp.where(chain_mask, chain_len, lengths)
+
+    def body(carry, _):
+        token, cache, lengths, active, key = carry
+        if key is not None:
+            key, k = jax.random.split(key)
+        else:
+            k = None
+        # Pre-step mask: the write for this step lands at position
+        # `lengths`, so it must be gated BEFORE the decode step runs.
+        step_active = active & (lengths < max_len)
+        logits, cache = GPT.decode_step_paged(
+            config, params, token, cache, page_table, lengths, step_active,
+            attn_impl=attn_impl, mesh=mesh, split_k=split_k,
+        )
+        cache = _maybe_constrain(cache, mesh)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            nxt = sample_logits(logits, k, temperature, top_k, top_p)
+        nxt = jnp.where(step_active, nxt.astype(token.dtype), token)
+        lengths = lengths + step_active.astype(lengths.dtype)
+        hit_eos = step_active & (eos >= 0) & (nxt == eos)
+        active = active & ~hit_eos
+        return (nxt, cache, lengths, active, key), (nxt, step_active)
+
+    (tok_fin, cache, len_fin, _, _), (toks, emitted) = jax.lax.scan(
+        body,
+        (token, cache, lengths, active, key),
+        None,
+        length=n_steps * round_group,
+    )
+    return cache, toks, emitted, tok_fin, len_fin
 
 
 @functools.partial(
@@ -439,6 +583,34 @@ class FinishedRequest:
     status: str = "ok"  # "ok" | "timeout" (deadline expired before finish)
 
 
+@dataclasses.dataclass
+class _InflightRound:
+    """A dispatched-but-unsettled decode group (round-overlap dispatch).
+
+    Holds the group program's UNFORCED device outputs plus the host-side
+    identity snapshot needed to settle it later: `slots` pins the exact
+    _Slot objects that were in the batch, so a settle after an eviction /
+    cancel / timeout skips any index whose slot object changed — the
+    in-flight tokens for a departed slot are simply discarded (recompute
+    preemption regenerates them bit-exactly; greedy streams are batch-
+    composition-independent). `worst_len` is the worst-case post-settle
+    length per slot — what the NEXT dispatch must assume for a chained
+    slot whose true device-side length (`len_fin`) it merges in-program.
+    """
+
+    toks: Array  # (T, B) int32, unforced
+    emitted: Array  # (T, B) bool, unforced
+    tok_fin: Array  # (B,) int32, unforced — next group's chain_token
+    len_fin: Array  # (B,) int32, unforced — next group's chain_len
+    n_steps: int  # T = n * round_group
+    active_idx: tp.List[int]
+    slots: tp.List[_Slot]
+    worst_len: np.ndarray  # (max_slots,) int32
+    round_no: int
+    t0: float
+    t1: float
+
+
 class ServeEngine:
     """Host-side continuous-batching scheduler (module docstring)."""
 
@@ -460,6 +632,8 @@ class ServeEngine:
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "auto",
         split_k="auto",  # "auto" | int — key partitions per attention call
+        overlap: str = "off",  # "off" | "double" | "group" (SERVING.md)
+        round_group: int = 1,  # fused rounds per dispatch (pow2-bucketed)
         max_backlog_pages: tp.Optional[int] = None,
         prefix_cache: bool = False,
         draft_params: tp.Optional[GPTParams] = None,
@@ -556,6 +730,34 @@ class ServeEngine:
         if split_k != "auto" and (not isinstance(split_k, int) or split_k < 1):
             raise ValueError(f"split_k must be 'auto' or a positive int, got {split_k!r}")
         self.split_k = split_k
+        # Round-overlap dispatch (docs/SERVING.md "Round-overlap dispatch"):
+        # "off" keeps the classic settle-every-round loop byte-identical;
+        # "group" fuses round_group decode rounds into one dispatched
+        # program (settled at the group edge, same step order otherwise);
+        # "double" additionally keeps ONE group in flight while the host
+        # phases of the previous round run (_step_overlapped). Both modes
+        # share _serve_decode_group, so flipping between them after warmup
+        # compiles nothing (tests/test_recompile_pins.py). Speculative
+        # engines ignore "double"/"group" for their spec rounds — a
+        # draft-then-verify round is already two fused dispatches with a
+        # host commit between, and overlapping it would re-order the
+        # rollback against the next draft — and run the classic step loop.
+        if overlap not in ("off", "double", "group"):
+            raise ValueError(
+                f"overlap must be 'off', 'double' or 'group', got {overlap!r}"
+            )
+        self.overlap = overlap
+        self.round_group = _round_group_bucket(round_group)
+        self._inflight: tp.Optional[_InflightRound] = None
+        # Killed in-flight overlapped groups (kill_overlapped_round chaos).
+        self.overlap_kills = 0
+        # (round, (uid, ...)) per decode dispatch — the deferred-effect
+        # observability hook: tests assert a request admitted/evicted
+        # during round N's host phase first appears/disappears in round
+        # N+2's dispatch (the one-round-late policy boundary).
+        self.dispatch_log: tp.Deque[tp.Tuple[int, tp.Tuple[int, ...]]] = (
+            collections.deque(maxlen=256)
+        )
         self.max_pages_per_slot = -(-config.block_size // page_size)
         cache_dtype = normalize_cache_dtype(cache_dtype)
         self.cache_dtype = cache_dtype
@@ -814,11 +1016,15 @@ class ServeEngine:
     def idle(self) -> bool:
         # A staged hot-swap counts as pending work: the drive loop must
         # keep stepping until the flip lands (sampling/ops.py), or a swap
-        # staged on a draining engine would never complete.
+        # staged on a draining engine would never complete. Likewise an
+        # unsettled in-flight decode group (overlap="double"): its tokens
+        # are not committed until the next step settles it, so the drive
+        # loop must take one more step even if every slot just drained.
         return (
             not self.queue
             and all(s is None for s in self.slots)
             and self._staged_swap is None
+            and self._inflight is None
         )
 
     def run(self) -> tp.Dict[int, FinishedRequest]:
@@ -900,6 +1106,10 @@ class ServeEngine:
         (sampling/ops.py)."""
         from midgpt_tpu.sampling import ops as _ops
 
+        # A resize migrates the resident working set out of self.cache —
+        # an unsettled in-flight group still writing into the OLD pool
+        # must land (and its tokens commit) before the migration reads it.
+        self._settle_inflight()
         return _ops.resize_pool(self, num_pages, max_slots=max_slots)
 
     def attach_spill(self, tier) -> None:
@@ -1040,6 +1250,7 @@ class ServeEngine:
         return {
             "prefill": jit_cache_size(_serve_prefill_chunk),
             "decode": jit_cache_size(_serve_decode_chunk),
+            "decode_group": jit_cache_size(_serve_decode_group),
             "spec_draft": jit_cache_size(_spec_draft_chunk),
             "spec_verify": jit_cache_size(_spec_verify_chunk),
         }
@@ -1069,6 +1280,9 @@ class ServeEngine:
             "cache_hbm_bytes": self.cache_hbm_bytes(),
             "cache_hbm_bytes_per_shard": self.cache_hbm_bytes_per_shard(),
             "rounds": self.rounds,
+            "overlap_mode": self.overlap,
+            "round_group": self.round_group,
+            "overlap_kills": self.overlap_kills,
             "preemptions": self.preemptions,
             "timeouts": self.timeouts,
             "shed": self.shed,
@@ -1094,11 +1308,21 @@ class ServeEngine:
         """One round: expire -> admit -> one prefill chunk -> one decode
         chunk (or one draft-then-verify speculative round).
 
-        The two serving fault hooks fire here (robustness/faults.py; an
+        The serving fault hooks fire here (robustness/faults.py; an
         empty registry — the default, always — costs a scan over nothing).
-        Both are keyed on the ROUND counter so chaos scenarios are
+        All are keyed on the ROUND counter so chaos scenarios are
         deterministic for a seeded trace (`kill_mid_decode@7` always
-        strikes round 7)."""
+        strikes round 7).
+
+        With overlap="double" (and no draft model) the round runs the
+        RESTRUCTURED order of `_step_overlapped` instead: dispatch this
+        round's decode group FIRST, then settle the previous round and run
+        every host phase while the new group computes behind the tunnel.
+        With overlap="group" the order below is unchanged — only the
+        decode call fuses `round_group` rounds into one dispatch."""
+        if self.overlap == "double" and self.draft_params is None:
+            self._step_overlapped()
+            return
         self.rounds += 1
         tr = self._trace
         t_round = 0.0 if self.obs is None else self._clock()
@@ -1131,8 +1355,84 @@ class ServeEngine:
             self._kill_decode_round()
         elif self.draft_params is not None:
             self._spec_round()
+        elif self.overlap == "group":
+            self._decode_round_grouped()
         else:
             self._decode_round()
+        if self.obs is not None:
+            tr.complete(
+                "engine.round", "round", self._obs_tid, t_round,
+                self._clock() - t_round, args={"round": self.rounds},
+            )
+
+    def _step_overlapped(self) -> None:
+        """One DOUBLE-BUFFERED round (overlap="double"): dispatch round
+        k's decode group FIRST — chaining device-side token/length state
+        from the still-unsettled round k-1 — then settle round k-1 and run
+        every host phase (expire, swap flip, admission, prefill) while
+        round k's program runs behind the tunnel. The settle's force waits
+        only for round k-1, never for round k, so round k-1's host
+        post-processing is HIDDEN under round k's device time — the
+        `overlap_hidden_ms` measure (obs/__init__.py).
+
+        The restructured order is what makes scheduler effects one round
+        late BY CONSTRUCTION (docs/SERVING.md "Round-overlap dispatch"):
+        round N's host phase runs here in step N+1, after dispatch
+        D_{N+1} is already in flight, so a request admitted or evicted
+        during it first appears/disappears in dispatch D_{N+2} — never
+        mid-flight. Faults that mutate the pool or the engine shape
+        (poisoned_page, evict_shared_prefix, hot_swap_mid_decode,
+        pool_resize) assume a settled round boundary, so the in-flight
+        group is drained before any of them strike."""
+        self.rounds += 1
+        tr = self._trace
+        t_round = 0.0 if self.obs is None else self._clock()
+        if self._inflight is not None and self._fault_needs_drain():
+            self._settle_inflight()
+        if self._inflight is not None and faults.should_fire(
+            "kill_overlapped_round", step=self.rounds
+        ):
+            tr.instant("fault.kill_overlapped_round", "fault", self._obs_tid)
+            self._kill_overlapped_round()
+        if faults.should_fire("poisoned_page", step=self.rounds):
+            tr.instant("fault.poisoned_page", "fault", self._obs_tid)
+            self._poison_page()
+        if faults.should_fire("evict_shared_prefix", step=self.rounds):
+            tr.instant("fault.evict_shared_prefix", "fault", self._obs_tid)
+            self._evict_shared_prefix_fault()
+        if faults.should_fire("hot_swap_mid_decode", step=self.rounds):
+            tr.instant("fault.hot_swap_mid_decode", "fault", self._obs_tid)
+            self._hot_swap_fault()
+        if faults.should_fire("pool_resize", step=self.rounds):
+            tr.instant("fault.pool_resize", "fault", self._obs_tid)
+            self._pool_resize_fault()
+        if faults.should_fire("kill_mid_decode", step=self.rounds):
+            # This round's dispatch dies: settle the previous group (its
+            # tokens landed before the failure), then recompute-preempt
+            # the decode-ready slots exactly like the classic path.
+            tr.instant("fault.kill_mid_decode", "fault", self._obs_tid)
+            self._settle_inflight()
+            self._kill_decode_round()
+            handle = None
+        else:
+            handle = self._dispatch_decode(self._inflight)
+        prev, self._inflight = self._inflight, handle
+        if prev is not None:
+            self._settle_round(prev)
+        with tr.span("engine.expire", "phase", self._obs_tid):
+            self._expire_round()
+        if self._staged_swap is not None:
+            # The flip reads/replaces engine weights and waits for a
+            # slot-free boundary — an unsettled group is pending work the
+            # drain must observe, so settle before consulting it.
+            self._settle_inflight()
+            from midgpt_tpu.sampling import ops as _ops
+
+            _ops.maybe_flip_swap(self)
+        with tr.span("engine.admit", "phase", self._obs_tid):
+            self._admit()
+        with tr.span("engine.prefill", "phase", self._obs_tid):
+            self._prefill_round()
         if self.obs is not None:
             tr.complete(
                 "engine.round", "round", self._obs_tid, t_round,
@@ -1161,6 +1461,247 @@ class ServeEngine:
         for s in sorted(victims, key=lambda s: s.admit_order, reverse=True):
             self._evict(s)
         self.decode_kills += 1
+
+    # -- round-overlap dispatch (docs/SERVING.md) ----------------------
+
+    # Faults that mutate the pool or the engine's shape mid-round; each
+    # assumes a settled round boundary, so an in-flight overlapped group
+    # is drained before any of them fires (_step_overlapped).
+    _DRAIN_FAULTS = (
+        "poisoned_page",
+        "evict_shared_prefix",
+        "hot_swap_mid_decode",
+        "pool_resize",
+    )
+
+    def _fault_needs_drain(self) -> bool:
+        """Peek (without consuming) whether a boundary-assuming fault can
+        fire this round — `faults.active()` is a copy, `should_fire` later
+        in the step still performs the one consuming match."""
+        for f in faults.active():
+            if (
+                f.kind in self._DRAIN_FAULTS
+                and f.times > 0
+                and (f.step is None or f.step == self.rounds)
+            ):
+                return True
+        return False
+
+    def _force(self, fn: tp.Callable[[], tp.Any], label: str) -> tp.Any:
+        """Route a host<->device force through the watchdog when armed —
+        the ONE funnel every decode-path sync takes, so a hang inside an
+        overlapped in-flight dispatch escalates exactly like a classic
+        round's (robustness/watchdog.py)."""
+        if self.watchdog is not None:
+            return self.watchdog.sync(fn, label=label)
+        return fn()
+
+    def _settle_inflight(self) -> None:
+        """Settle the in-flight group now, if any (drain point for mode
+        flips, pool mutations, and engine teardown paths)."""
+        h, self._inflight = self._inflight, None
+        if h is not None:
+            self._settle_round(h)
+
+    def _kill_overlapped_round(self) -> None:
+        """The `kill_overlapped_round` fault: the in-flight group's
+        dispatch died while the previous round's host work ran (device
+        restart / tunnel drop with TWO rounds in the pipe). Its tokens
+        never land — the handle is dropped WITHOUT forcing — and every
+        slot that was in the killed batch is recompute-preempted, the
+        same recovery (and the same greedy-parity guarantee) as
+        kill_mid_decode — pinned end to end by tests/test_chaos_serve.py
+        ::test_chaos_kill_overlapped_round_recompute_parity. Slots that
+        already departed are skipped; bystanders (mid-prefill slots,
+        other streams) are untouched."""
+        h, self._inflight = self._inflight, None
+        if h is None:
+            return
+        self.overlap_kills += 1
+        victims = [
+            s
+            for idx, s in zip(h.active_idx, h.slots)
+            if self.slots[idx] is s and s.remaining > 0
+        ]
+        for s in sorted(victims, key=lambda s: s.admit_order, reverse=True):
+            self._evict(s)
+
+    def _decode_round_grouped(self) -> None:
+        """overlap="group": one fused multi-round dispatch, settled at
+        the group edge within the same step (no in-flight carry-over)."""
+        h = self._dispatch_decode(None)
+        if h is not None:
+            self._settle_round(h)
+
+    def _dispatch_decode(
+        self, prev: tp.Optional[_InflightRound]
+    ) -> tp.Optional[_InflightRound]:
+        """Assemble and ENQUEUE one multi-round decode group without
+        forcing it; returns the in-flight handle (None when nothing can
+        decode). `prev` is the still-unsettled previous group under
+        double-buffering: its slots are CHAINED — their true token/length
+        state rides in on the previous program's unforced outputs and is
+        merged in-program under `chain_mask`, so the host's one-round-
+        stale view never reaches the device. Page provisioning for a
+        chained slot budgets from its WORST-CASE post-settle length
+        (prev.worst_len); if the pool can't cover a full group it falls
+        back to one sub-round, and failing that the slot rides along
+        masked (chained — the device takes zero steps for it) or defers
+        to a later round (fresh)."""
+        chained: tp.Set[int] = set()
+        if prev is not None:
+            chained = {
+                idx
+                for idx, s in zip(prev.active_idx, prev.slots)
+                if self.slots[idx] is s
+            }
+        S = self.config.block_size
+        ps = self.page_size
+
+        def _want(s: _Slot) -> int:
+            # The settle bound: at length P + max_new - 1 the request has
+            # committed its full generation budget (_append_token's count).
+            req = s.request
+            return min(len(req.prompt) + req.max_new_tokens - 1, S)
+
+        def _base(i: int, s: _Slot) -> int:
+            return int(prev.worst_len[i]) if i in chained else s.length
+
+        cand = []
+        for i, s in enumerate(self.slots):
+            if s is None or s.prefilling:
+                continue
+            if i not in chained and s.remaining <= 0:
+                continue
+            if _base(i, s) < _want(s):
+                cand.append((i, s))
+        if not cand:
+            return None
+        need = min(
+            self.decode_chunk, max(_want(s) - _base(i, s) for i, s in cand)
+        )
+        n = 1 << (need.bit_length() - 1)  # largest power of two <= need
+        T = n * self.round_group
+        for i, slot in list(cand):
+            if self.slots[i] is not slot:
+                continue  # evicted by an older slot's growth in this loop
+            upto = min(_want(slot), _base(i, slot) + T)
+            if not self._ensure_pages(slot, upto):
+                fallback = min(_want(slot), _base(i, slot) + n)
+                if not self._ensure_pages(slot, fallback) and i not in chained:
+                    # Pool held by slots at least as old — defer (classic
+                    # _decode_round behavior). A chained slot keeps riding:
+                    # its provisioned pages already cover worst_len, so
+                    # max_len clamps it to zero steps, never to an overrun.
+                    cand = [(j, t) for j, t in cand if j != i]
+        cand = [(i, s) for i, s in cand if self.slots[i] is s]
+        if not cand:
+            return None
+
+        obs = self.obs
+        t0 = 0.0 if obs is None else self._clock()
+        B = self.max_slots
+        token = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        eos = np.full((B,), -1, np.int32)
+        max_len = np.zeros((B,), np.int32)
+        chain_mask = np.zeros((B,), bool)
+        worst = np.zeros((B,), np.int32)
+        for i, s in cand:
+            token[i] = s.generated[-1] if s.generated else s.request.prompt[-1]
+            lengths[i] = s.length
+            active[i] = True
+            if s.request.eos_id is not None:
+                eos[i] = s.request.eos_id
+            max_len[i] = min(_want(s), len(s.pages) * ps)
+            chain_mask[i] = i in chained
+            worst[i] = min(_base(i, s) + T, max_len[i])
+        if self.temperature == 0.0:
+            key = None
+        else:
+            self._key, key = jax.random.split(self._key)
+        round_span = int(worst.max())
+        bucket = self._page_bucket(round_span)
+        # Chain carry-in: the previous group's unforced outputs when
+        # chaining, else zero fillers of the same shape/dtype — ONE
+        # compiled program serves both cases, and nothing here syncs.
+        if prev is not None:
+            chain_token, chain_len = prev.tok_fin, prev.len_fin
+        else:
+            chain_token = np.zeros((B,), np.int32)
+            chain_len = np.zeros((B,), np.int32)
+        self.cache, toks, emitted, tok_fin, len_fin = _serve_decode_group(
+            self.config,
+            self.params,
+            jnp.asarray(token),
+            self.cache,
+            jnp.asarray(self._page_table(bucket)),
+            jnp.asarray(lengths),
+            jnp.asarray(active),
+            jnp.asarray(eos),
+            jnp.asarray(max_len),
+            jnp.asarray(chain_mask),
+            jnp.asarray(chain_token),
+            jnp.asarray(chain_len),
+            n,
+            self.round_group,
+            self.temperature,
+            self.top_k,
+            self.top_p,
+            self.attn_impl,
+            key,
+            self.mesh,
+            self._split_bucket(round_span),
+        )
+        t1 = 0.0 if obs is None else self._clock()
+        self.dispatch_log.append(
+            (self.rounds, tuple(s.request.uid for _, s in cand))
+        )
+        return _InflightRound(
+            toks=toks,
+            emitted=emitted,
+            tok_fin=tok_fin,
+            len_fin=len_fin,
+            n_steps=T,
+            active_idx=[i for i, _ in cand],
+            slots=[s for _, s in cand],
+            worst_len=worst,
+            round_no=self.rounds,
+            t0=t0,
+            t1=t1,
+        )
+
+    def _settle_round(self, h: _InflightRound) -> None:
+        """Force a dispatched group and commit its tokens. Indices whose
+        slot object changed since dispatch (finished, evicted, cancelled,
+        timed out) are SKIPPED — their in-flight tokens are discarded, and
+        recompute preemption regenerates them bit-exactly. The force is
+        the round's one host<->device sync, watchdog-bounded; under
+        double-buffering the time between dispatch-return (h.t1) and this
+        force starting is host work the overlap HID, recorded as
+        `overlap_hidden` in the round decomposition (obs/__init__.py)."""
+        obs = self.obs
+        t_force = 0.0 if obs is None else self._clock()
+        toks, emitted = self._force(
+            lambda: (np.asarray(h.toks), np.asarray(h.emitted)),
+            "serve.overlap_sync",
+        )
+        t_done = self._clock()
+        for idx, s in zip(h.active_idx, h.slots):
+            if self.slots[idx] is not s:
+                continue
+            for j in range(h.n_steps):
+                if not emitted[j, idx]:
+                    continue
+                s.length += 1
+                if self._append_token(idx, s, int(toks[j, idx]), t_done):
+                    break  # finished (max_new or EOS); rest discarded
+        if obs is not None:
+            obs.record_round(
+                "decode", self._obs_tid, h.t0, h.t1, t_done, self._clock(),
+                hidden_s=max(0.0, t_force - h.t1),
+            )
 
     def _poison_page(self) -> None:
         """The `poisoned_page` fault: corrupt the first page of the
@@ -1623,14 +2164,17 @@ class ServeEngine:
             self._split_bucket(round_span),
         )
         t1 = 0.0 if obs is None else self._clock()
-        if self.watchdog is not None:
-            # Arm the deadline around the round's ONE host<->device sync —
-            # the force below is where a dead tunnel would wedge forever.
-            toks = self.watchdog.sync(
-                lambda: np.asarray(toks), label="serve.decode_sync"
+        self.dispatch_log.append(
+            (
+                self.rounds,
+                tuple(self.slots[i].request.uid for i in active_idx),
             )
-        else:
-            toks = np.asarray(toks)  # (n, B) — forces the dispatch
+        )
+        # The round's ONE host<->device sync; watchdog-bounded when armed —
+        # the force below is where a dead tunnel would wedge forever.
+        toks = self._force(
+            lambda: np.asarray(toks), "serve.decode_sync"
+        )  # (n, B)
         t_done = self._clock()
         for i in active_idx:
             slot = self.slots[i]

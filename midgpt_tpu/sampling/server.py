@@ -48,6 +48,17 @@ docs/ROBUSTNESS.md "Serving faults & SLOs"):
     `preempt.requested()` each round) stops admission — further submits
     raise `ServerDraining` — finishes every in-flight request, then lets
     `run()` return.
+
+Round-overlap dispatch (docs/SERVING.md "Round-overlap dispatch") changes
+nothing structurally here, and that is the point: tokens only ever reach
+the `on_token` hooks from SETTLED rounds — the engine's step() commits a
+round's tokens after its force lands, and under overlap="double" that is
+one step later than the dispatch. A client therefore never streams a
+token the engine could still discard (an in-flight round killed by
+`kill_overlapped_round` drops un-settled tokens and recompute-preempts;
+anything already streamed was settled and stays bit-final). The driver
+loop's `engine.idle` check also covers the in-flight handle, so drain
+waits for the last overlapped round to settle before `run()` returns.
 """
 
 from __future__ import annotations

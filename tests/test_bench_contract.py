@@ -69,6 +69,7 @@ def test_bench_serve_emits_conformant_json_line(capsys):
     assert rec["compile_counts"]["prefill"] >= 1
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_bench_serve_spec_emits_conformant_json_line(capsys):
     """--spec mode: the serve_spec profile (speculative vs plain continuous
     engine) must hold the one-JSON-line contract too. Tiny shapes, 2 quick
@@ -246,6 +247,7 @@ def test_bench_serve_longctx_emits_conformant_json_line(capsys):
     )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_bench_serve_ops_emits_conformant_json_line(capsys):
     """--hot-swap mode: the serve_ops profile (verified-checkpoint
     blue/green swap mid-trace + live pool grow) must hold the one-JSON-
@@ -387,6 +389,7 @@ def test_loadgen_hot_swap_surfaces_version_transition(capsys):
     assert rec["slo_ok"] is True
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_loadgen_prefix_cache_emits_hit_rate(capsys):
     """tools/loadgen.py --prefix-cache: the serve_slo line still conforms
     and carries per-point + headline prefix_hit_rate fields."""
@@ -493,6 +496,7 @@ def test_loadgen_emits_conformant_serve_slo_line(capsys):
     assert isinstance(rec["slo_ok"], bool)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_bench_train_emits_conformant_json_line(capsys):
     out = _run_entry_point(
         os.path.join(REPO, "bench.py"),
@@ -619,6 +623,8 @@ def test_serve_slo_checker_catches_drift():
         "ttft_p50_ms": 5.0, "ttft_p95_ms": 9.0, "tpot_p50_ms": 1.0,
         "tpot_p95_ms": 2.0, "rounds": 8,
         "round_host_ms": dict(decomp), "round_device_ms": dict(decomp),
+        "overlap_hidden_ms": dict(decomp), "overlap_mode": "off",
+        "round_group": 1,
     }
     good = {
         "bench": "serve_slo", "backend": "cpu", "process": "poisson",
@@ -628,8 +634,16 @@ def test_serve_slo_checker_catches_drift():
         "ttft_p50_ms": 5.0, "ttft_p95_ms": 9.0, "tpot_p50_ms": 1.0,
         "tpot_p95_ms": 2.0, "shed_frac": 0.0, "timeout_frac": 0.0,
         "round_host_ms": dict(decomp), "round_device_ms": dict(decomp),
+        "overlap_hidden_ms": dict(decomp), "overlap_mode": "off",
+        "round_group": 1,
     }
     assert check_serve_slo_bench(good) == []
+    # round-overlap drift (docs/SERVING.md "Round-overlap dispatch"): a
+    # bad mode name fails, and round_group != 1 demands mode == "group"
+    assert any("overlap_mode" in p
+               for p in check_serve_slo_bench(dict(good, overlap_mode="on")))
+    assert any("round_group" in p
+               for p in check_serve_slo_bench(dict(good, round_group=2)))
     # round-decomposition drift (docs/OBSERVABILITY.md): a missing or
     # malformed host/device object fails, as does a negative quantile
     no_decomp = dict(good, round_host_ms=None)

@@ -69,6 +69,7 @@ def force_flash_interpret(monkeypatch):
     monkeypatch.setattr(fa, "RUN_INTERPRET_OFF_TPU", True)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_model_end_to_end_flash_matches_naive(force_flash_interpret):
     """Full GPT fwd+bwd with attn_impl='flash' vs 'naive'."""
     cfg = GPTConfig(

@@ -101,6 +101,7 @@ def test_moe_top_k_routing_properties():
         assert float(jnp.abs(leaf).max()) > 0
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_moe_train_step_ep2_matches_ep1():
     """Expert parallelism: one full train step with the experts sharded over
     a real 'ep' axis reproduces the unsharded (ep=1) loss — same math,

@@ -97,6 +97,7 @@ def test_pipeline_gradients_match_dense():
         )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_pipeline_train_step_matches_fsdp_only():
     """One full training step on a (data=2, pp=4) mesh reproduces the
     FSDP-only oracle's loss on the same batch and seed."""
@@ -143,6 +144,7 @@ def test_pipeline_train_step_matches_fsdp_only():
     np.testing.assert_allclose(evals["pp"], evals["oracle"], rtol=1e-5)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_pipeline_fsdp_composition_train_step_matches_oracle():
     """v2: stage weights shard over 'fsdp' (per-layer gathers inside the
     stage scan, ZeRO-3 style) — one full train step + eval on a
@@ -245,6 +247,7 @@ def test_pipeline_tp_composition_train_step_matches_oracle():
     assert specs.blocks.mlp.w_down == P("pp", "fsdp", "tp")
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_1f1b_loss_and_grads_match_gpipe():
     """The hand-written 1F1B backward (make_pipeline_loss_and_grad) computes
     the SAME loss and gradients as reverse AD of the GPipe schedule — and
@@ -281,6 +284,7 @@ def test_1f1b_loss_and_grads_match_gpipe():
     np.testing.assert_allclose(float(l_f), float(want), rtol=1e-5)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_1f1b_grads_match_gpipe_with_fsdp_replicated_leaves():
     """Regression (r5 review): with mesh.fsdp>1 and block leaves that are
     fsdp-REPLICATED (here: default fsdp_min_size leaves q/k scales and, with
@@ -315,6 +319,7 @@ def test_1f1b_grads_match_gpipe_with_fsdp_replicated_leaves():
         )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_1f1b_activation_stash_is_m_independent():
     """THE point of 1F1B (VERDICT r4 #5): growing the microbatch count must
     not grow the backward's activation memory. Compare compiled temp memory
@@ -355,6 +360,7 @@ def test_1f1b_activation_stash_is_m_independent():
     )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_1f1b_train_step_matches_gpipe_step():
     """One full training step with pipeline_schedule='1f1b' reproduces the
     GPipe step's loss (same params/batch/seed) through make_train_step."""

@@ -225,6 +225,7 @@ def test_prefix_cow_duplicate_prompt_isolated(params):
     np.testing.assert_array_equal(eng.finished[u2].tokens, np.asarray(ref[0]))
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_prefix_parity_int8_shares_scales(params):
     """int8 pool mode: the per-page absmax scales are indexed by physical
     page alongside the int8 columns, so a shared page shares its scales by
@@ -241,6 +242,7 @@ def test_prefix_parity_int8_shares_scales(params):
     _assert_conserved(eng_on)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_prefix_parity_spec_self_draft(params):
     """Speculative self-draft mode: the draft IS the target's first layers
     on the target's pool, so trie-shared pages serve draft and verify alike
@@ -265,6 +267,7 @@ def test_prefix_parity_spec_self_draft(params):
     _assert_conserved(eng_on)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_r10_preemption_resume_skips_self_reprefill(params):
     """The r10 regression pin. UNIQUE prompts in a pool too small for the
     working set: sharing between requests is impossible, so every trie hit

@@ -152,6 +152,8 @@ def test_spec_mixes_one_draft_and_verify_program_per_k_bucket(params):
     assert stats["spec_verify"] == jit_cache_size(_spec_verify_chunk)
 
 
+@pytest.mark.slow  # heavy long-tail (~10 s of int8 compiles): full suite
+# only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_int8_cache_is_a_program_key_but_compiles_once_per_bucket(params):
     """Satellite pin (int8 KV-cache PR): the cache dtype IS part of the
     program key — the int8 pool's avals (s8 pages + f32 scale leaves)
@@ -271,6 +273,7 @@ def test_obs_toggle_compiles_zero_new_programs(params):
     assert eng.stats()["obs"]["round_decomp"]["rounds"] > 0
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_hot_swap_and_ops_ticks_compile_zero_new_programs(params):
     """Tentpole pin (model-ops PR): a same-shape blue/green hot-swap is a
     pointer flip — the candidate params are device_put onto the LIVE
@@ -336,6 +339,8 @@ def test_hot_swap_and_ops_ticks_compile_zero_new_programs(params):
     assert cc.count == 0, f"hot-swap/ops ticks compiled {cc.count} program(s)"
 
 
+@pytest.mark.slow  # heavy long-tail (~9 s, two fresh pool geometries):
+# full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_resize_compiles_bounded_then_zero_on_replay(params):
     """Satellite pin (model-ops PR): a live pool resize may compile only
     the migration's pow2-bucketed gather/scatter programs and the
@@ -380,6 +385,55 @@ def test_resize_compiles_bounded_then_zero_on_replay(params):
     with CompileCounter() as cc:
         mix(seed=1)
     assert cc.count == 0, f"resize replay compiled {cc.count} program(s)"
+
+
+@pytest.mark.slow  # heavy long-tail (~10 s, cold geometry-61 compiles):
+# full suite only; the audit-suite group census stays tier-1
+def test_overlap_modes_compile_one_group_program_per_bucket(params):
+    """Tentpole pin (round-overlap PR): the fused group program compiles
+    exactly once per (geometry, round_group bucket) — round_group is a
+    pow2-bucketed static (`_round_group_bucket`), so group:3 reuses
+    group:2's program — and flipping the overlap mode off<->double<->group
+    on warm programs compiles NOTHING: overlap is host-side dispatch
+    restructuring over the same jit inputs. Geometry 61 is this pin's own
+    fresh pool (tests/test_overlap.py warms 39; the baselines above own
+    25/31/51/57/71)."""
+    from midgpt_tpu.sampling.serve import _serve_decode_group
+
+    def mix(overlap, round_group, seed):
+        eng = ServeEngine(
+            CFG, params, max_slots=3, page_size=8, num_pages=61,
+            prefill_chunk=16, decode_chunk=8, temperature=0.0,
+            cache_dtype=jnp.float32, overlap=overlap,
+            round_group=round_group,
+        )
+        rng = np.random.default_rng(seed)
+        uids = {
+            eng.submit(rng.integers(0, CFG.vocab_size, n).astype(np.int32), m)
+            for n, m in zip((25, 34, 47), (9, 17, 17))
+        }
+        assert set(eng.run()) == uids
+        return eng
+
+    mix("off", 1, seed=0)  # warm prefill buckets + the classic decode
+    g0 = jit_cache_size(_serve_decode_group)
+    mix("double", 1, seed=1)
+    g1 = jit_cache_size(_serve_decode_group)
+    assert g1 - g0 == 1, "double-buffering must be ONE group program (k=1)"
+    mix("group", 2, seed=2)
+    g2 = jit_cache_size(_serve_decode_group)
+    assert g2 - g1 == 1, "group:2 must be ONE more program (k-bucket 2)"
+    eng = mix("group", 3, seed=3)  # 3 buckets down to 2: same program
+    assert eng.round_group == 2
+    assert jit_cache_size(_serve_decode_group) == g2, (
+        "round_group=3 must bucket to the k=2 program, not compile a third"
+    )
+    with CompileCounter() as cc:
+        mix("off", 1, seed=4)
+        mix("double", 1, seed=5)
+        mix("group", 2, seed=6)
+    assert cc.count == 0, f"overlap mode flip compiled {cc.count} program(s)"
+    assert ServeEngine.compile_stats()["decode_group"] == g2
 
 
 def test_train_step_compiles_exactly_once():
@@ -480,4 +534,11 @@ def test_audit_suite_passes_on_cpu_mesh():
     # carries (tp_decode_split is asserted with the rest of TP_PROGRAMS)
     assert report["split_decode_while_bodies"], "split decode lost its scan?"
     for key in budgets.SPLIT_ZERO_COLLECTIVE_KEYS + budgets.SPLIT_ZERO_COPY_KEYS:
+        assert all(n == zero for n in report[key].values()), key
+    # round-overlap extensions: the fused multi-round group program must
+    # add ZERO in-loop pool/scale traffic and zero collectives at every
+    # audited k — a group multiplies any in-loop copy cost by k, so the
+    # census is the load-bearing claim of the fusion (budgets.py)
+    for key in budgets.GROUP_ZERO_COLLECTIVE_KEYS + budgets.GROUP_ZERO_COPY_KEYS:
+        assert report[key], f"{key}: group program lost its scan?"
         assert all(n == zero for n in report[key].values()), key

@@ -53,6 +53,7 @@ def test_ring_gradients_match(sp=2):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_ring_long_context_t4096():
     """T=4096 across sp=4: per-device score blocks are (1024, 1024) — the
     full T x T matrix is never materialized on any device."""
@@ -217,6 +218,7 @@ def test_ring_kernel_auto_falls_back_on_unservable_shard():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_ring_kernel_block_auto_adjusts_to_divisor():
     """Tl=1280 at the default block 1024 does NOT fall back: the plan
     auto-adjusts to the largest 8-aligned divisor in [128, 1024] (640) and

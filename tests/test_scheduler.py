@@ -142,6 +142,7 @@ def test_slo_policy_keeps_greedy_parity_and_compiles_nothing(params):
         )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_slo_policy_preempts_most_slack_slot_under_pressure(params):
     """On an oversubscribed pool the SLO engine evicts the younger slot
     with the MOST deadline slack: the urgent request streams through

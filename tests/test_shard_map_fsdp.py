@@ -351,6 +351,7 @@ def test_loss_and_grads_match_gspmd_with_ring():
         )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_train_step_shard_map_ring_matches_gspmd_sp1():
     """One full training step: fsdp_mode='shard_map' + ring/sp=2 produces
     the same loss as the implicit-GSPMD naive sp=1 step on the same batch

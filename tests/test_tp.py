@@ -154,6 +154,7 @@ def data_dir(tmp_path_factory):
     return str(d)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_tp_train_step_matches_fsdp_only(data_dir):
     """5-step loss trajectory: (data=2, fsdp=2, tp=2) == (data=2, fsdp=4).
 
@@ -187,6 +188,7 @@ def test_tp_train_step_matches_fsdp_only(data_dir):
     assert losses_ref[-1] < losses_ref[0]  # and it actually learns
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_tp_ring_sp_composition_matches_fsdp_only(data_dir):
     """All four parallelism kinds at once: a (data=1, fsdp=2, sp=2, tp=2)
     mesh — real FSDP param sharding, ring attention over 'sp', and

@@ -133,6 +133,7 @@ def _pin_mix(params, mesh, lengths, max_new, seed, *, dtype=jnp.float32,
     assert set(eng.run()) == uids
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_tp_mix_change_compiles_nothing(params, mesh):
     """Recompile pin (mirrors tests/test_recompile_pins.py): the tp engine
     compiles one decode program per cache dtype and one draft+verify
@@ -240,6 +241,7 @@ def test_disagg_parity(params, dtype):
     assert st["fallback_reprefills"] == 0
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_disagg_on_role_mesh(params):
     """Roles on the data axis of a (data=2, tp=2) mesh over 4 devices:
     prefill row 0, decode row 1, both tp-sharded — still bit-identical to

@@ -74,6 +74,7 @@ def test_loss_decreases(data_dir):
     assert m["loss/final"] < m["loss/val"], "loss did not improve"
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_grad_accum_equivalence(data_dir):
     """G=2 with batch B must match G=1 with batch 2B (same data, same key)."""
     cfg1 = tiny_config(data_dir, g_accum_iters=1, batch_size=16, compute_dtype="float32")
@@ -154,6 +155,7 @@ def test_evaluate_chunked_matches_monolithic(data_dir):
     np.testing.assert_array_equal(xa[2:5], xs)
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_divergence_guard_stops_loudly(data_dir, tmp_path):
     """A diverging run (absurd lr) must raise FloatingPointError instead of
     training on — or CHECKPOINTING — NaNs (auxiliary failure-detection the
@@ -259,6 +261,7 @@ def test_qkv_proj_validated_at_construction(data_dir):
         )
 
 
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
 def test_resume_rejects_corrupt_checkpoint(data_dir, tmp_path):
     """The health induction's base case: a restored checkpoint containing
     NaN (corruption, bad migration) must abort the resume, not train on."""

@@ -20,7 +20,10 @@ TPU tunnel is untrustworthy below many iterations, so treat the CPU-mesh
 numbers as scheduling-structure signal, not kernel-speed signal). The
 timed continuous run carries a flight recorder (midgpt_tpu/obs/): the
 line reports `round_host_ms`/`round_device_ms` p50/p95 — the decode-round
-host-vs-device split — and `--trace-out DIR` dumps the Chrome trace.
+host-vs-device split — plus `overlap_mode`/`round_group`/
+`overlap_hidden_ms` (the round-overlap dispatch A/B identity, driven by
+`--overlap {off,double,group:k}`), and `--trace-out DIR` dumps the
+Chrome trace.
 
     python tools/bench_serve.py [--n-requests 12] [--max-slots 4] ...
 """
@@ -1076,6 +1079,15 @@ def main() -> int:
                     "continuous run's flight recorder as a Chrome-trace "
                     "JSON (+ .prom metrics) — open in Perfetto or roll up "
                     "with tools/trace_view.py (docs/OBSERVABILITY.md)")
+    ap.add_argument("--overlap", type=str, default="off",
+                    help="round-overlap dispatch A/B for the plain serve "
+                    "profile (docs/SERVING.md 'Round-overlap dispatch'): "
+                    "'off' (classic rounds), 'double' (dispatch round N+1 "
+                    "before round N's host post-processing), or 'group:k' "
+                    "(fuse k decode rounds into one on-device scan). The "
+                    "line reports overlap_mode/round_group/"
+                    "overlap_hidden_ms either way — an honest zero when "
+                    "off — so A/B records are self-describing")
     args = ap.parse_args()
     if args.n_layer is None:
         args.n_layer = 6 if args.spec else 4
@@ -1097,7 +1109,9 @@ def main() -> int:
 
     from midgpt_tpu.models.gpt import GPT, GPTConfig, KVCache
     from midgpt_tpu.sampling.engine import generate
-    from midgpt_tpu.sampling.serve import ServeEngine
+    from midgpt_tpu.sampling.serve import ServeEngine, parse_overlap
+
+    overlap_mode, round_group = parse_overlap(args.overlap)
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = GPTConfig(
@@ -1172,6 +1186,8 @@ def main() -> int:
             temperature=0.0,
             cache_dtype=dtype,
             obs=obs,
+            overlap=overlap_mode,
+            round_group=round_group,
             **pool_kw,
         )
         uids = [(eng.submit(p, m), len(p)) for p, m in trace]
@@ -1288,6 +1304,15 @@ def main() -> int:
                 "decode_rounds": decomp["rounds"],
                 "round_host_ms": round_host_ms,
                 "round_device_ms": round_device_ms,
+                # round-overlap dispatch A/B identity + the host time the
+                # overlap hid (docs/SERVING.md; eng.round_group is the
+                # pow2-bucketed value that actually ran, not the CLI ask)
+                "overlap_mode": eng.overlap,
+                "round_group": eng.round_group,
+                "overlap_hidden_ms": {
+                    "p50": decomp["overlap_hidden"]["p50_ms"],
+                    "p95": decomp["overlap_hidden"]["p95_ms"],
+                },
                 # pools + (int8) scale side buffers — the true cache spend
                 "cache_hbm_bytes": int(paged_bytes),
                 "hbm_paged_cache_bytes": int(paged_bytes),

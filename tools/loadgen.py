@@ -27,9 +27,12 @@ Every engine runs under a per-point flight recorder (midgpt_tpu/obs/):
 each point (and the headline, from the hottest point) carries
 `round_host_ms`/`round_device_ms` p50/p95 — the decode-round split into
 host work (batch assembly + jit enqueue + token commit) vs device wait
-(docs/OBSERVABILITY.md). `--trace-out DIR` additionally dumps one
-Chrome-trace JSON (+ .prom metrics) per point for Perfetto /
-tools/trace_view.py.
+(docs/OBSERVABILITY.md) — plus `overlap_mode`/`round_group`/
+`overlap_hidden_ms`, the round-overlap dispatch A/B identity driven by
+`--overlap {off,double,group:k}` (docs/SERVING.md "Round-overlap
+dispatch"; the TPOT-vs-mode comparison is THE acceptance A/B for ROADMAP
+item 3). `--trace-out DIR` additionally dumps one Chrome-trace JSON
+(+ .prom metrics) per point for Perfetto / tools/trace_view.py.
 
 Client-perceived metrics: TTFT is measured from the client's submit
 attempt (admission retries and queueing included — that is what a user
@@ -368,6 +371,14 @@ def main() -> int:
                     "fleet_size / failovers / fleet-wide prefix_hit_rate "
                     "/ spill_hits (docs/ROBUSTNESS.md 'Fleet serving & "
                     "failover'). Incompatible with --hot-swap and --tp")
+    ap.add_argument("--overlap", type=str, default="off",
+                    help="round-overlap dispatch mode for every engine "
+                    "(docs/SERVING.md 'Round-overlap dispatch'): 'off', "
+                    "'double' (dispatch round N+1 before round N's host "
+                    "phase), or 'group:k' (fuse k rounds per dispatch). "
+                    "Fixed offered load + --overlap off vs double is the "
+                    "TPOT A/B; points and headline carry overlap_mode / "
+                    "round_group / overlap_hidden_ms either way")
     # engine/model shape (tiny defaults: the CPU-mesh scheduling testbed)
     ap.add_argument("--max-slots", type=int, default=3)
     ap.add_argument("--page-size", type=int, default=8)
@@ -425,8 +436,10 @@ def main() -> int:
     from midgpt_tpu.models.gpt import GPT, GPTConfig
     from midgpt_tpu.obs import Observability
     from midgpt_tpu.sampling.scheduler import FCFSScheduler, SLOScheduler
-    from midgpt_tpu.sampling.serve import ServeEngine
+    from midgpt_tpu.sampling.serve import ServeEngine, parse_overlap
     from midgpt_tpu.sampling.server import AsyncServeServer
+
+    overlap_mode, overlap_group = parse_overlap(args.overlap)
 
     cfg = GPTConfig(
         block_size=args.block_size,
@@ -473,6 +486,8 @@ def main() -> int:
             prefix_cache=bool(args.prefix_cache),
             mesh=mesh,
             obs=obs,
+            overlap=overlap_mode,
+            round_group=overlap_group,
         )
 
     # Warm EVERY (decode-chunk tail x page bucket) program the workload
@@ -588,6 +603,12 @@ def main() -> int:
                 "p50": decomp["device_wait"]["p50_ms"],
                 "p95": decomp["device_wait"]["p95_ms"],
             }
+            stats["overlap_mode"] = warm.overlap
+            stats["round_group"] = warm.round_group
+            stats["overlap_hidden_ms"] = {
+                "p50": decomp["overlap_hidden"]["p50_ms"],
+                "p95": decomp["overlap_hidden"]["p95_ms"],
+            }
             if args.trace_out:
                 obs.dump(
                     args.trace_out,
@@ -661,6 +682,14 @@ def main() -> int:
             "p50": decomp["device_wait"]["p50_ms"],
             "p95": decomp["device_wait"]["p95_ms"],
         }
+        # round-overlap A/B identity (engine.round_group is the bucketed
+        # value that actually ran) + the host time the overlap hid
+        stats["overlap_mode"] = engine.overlap
+        stats["round_group"] = engine.round_group
+        stats["overlap_hidden_ms"] = {
+            "p50": decomp["overlap_hidden"]["p50_ms"],
+            "p95": decomp["overlap_hidden"]["p95_ms"],
+        }
         if args.trace_out:
             obs.dump(args.trace_out, filename=f"loadgen_point{pi}_r{rate:g}.json")
         points.append(stats)
@@ -706,6 +735,9 @@ def main() -> int:
                 "timeout_frac": worst["timeout_frac"],
                 "round_host_ms": worst["round_host_ms"],
                 "round_device_ms": worst["round_device_ms"],
+                "overlap_mode": worst["overlap_mode"],
+                "round_group": worst["round_group"],
+                "overlap_hidden_ms": worst["overlap_hidden_ms"],
                 "prefix_hit_rate": worst.get("prefix_hit_rate"),
                 # --fleet: availability/affinity headline from the hottest
                 # point (docs/ROBUSTNESS.md "Fleet serving & failover");
