@@ -798,6 +798,10 @@ def check_graftcheck(rec: dict) -> tp.List[str]:
             "pass3_count": (int,),
             "pass3_suppressed": (int,),
             "pass3_wall_ms": (int, float),
+            "pass4_count": (int,),
+            "pass4_suppressed": (int,),
+            "pass4_wall_ms": (int, float),
+            "jit_surface_count": (int,),
         },
         problems,
     )

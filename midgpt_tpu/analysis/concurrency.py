@@ -1,0 +1,656 @@
+"""graftcheck pass 4: thread/process-boundary concurrency rules. JAX-free.
+
+ROADMAP items 4-5 promote today's in-process seams (FleetRouter<->replicas,
+handoff/spill queues, `jax.distributed` training) to real thread and process
+boundaries. Pass 3's GC010 guards the async front door; these rules make the
+remaining boundary disciplines lexical *before* the process split, so a
+violation fails CI with a file:line instead of surfacing as a rare
+interleaving (rationale and citations: docs/ANALYSIS.md pass-4 section):
+
+  GC013  thread confinement: engine/pool/trie/scheduler state may only be
+         mutated from the driver loop. Any function reachable from a
+         non-driver execution context — a `threading.Thread`/`Timer`
+         target, an `asyncio.to_thread`/`run_in_executor` callee other
+         than the blessed bound-`step` funnel or a queued-command def
+         nested in the awaiting coroutine (GC010's clean idiom), or an
+         `on_expire=` watchdog callback — must not store to (or call
+         mutating methods on) engine-owned state; workers hand results
+         back through queues/events the driver drains.
+  GC014  signal-handler safety: a handler registered via `signal.signal`
+         runs at an arbitrary bytecode boundary on the main thread. It may
+         only set pre-existing flags: no checkpoint/collective calls, no
+         engine/pool calls, no prints/logging/IO, no lock acquisition or
+         primitive construction, no comprehension allocation. The one-shot
+         re-arm (`signal.signal(signum, previous)` inside the handler) is
+         the blessed exception (robustness/preempt.py).
+  GC015  wire contract: values placed into `PageHandoffQueue` / SpillTier /
+         FleetRouter failover structures must be plain data by
+         construction — host numpy pages under the quantized-page+scales
+         keys {k, v, k_scale, v_scale}, ints/floats/strings for the rest.
+         No device arrays (a bare jnp/jax call landing in a field), no
+         closures/lambdas, no locks, no clock callables: every one of
+         those dies (or silently diverges) at pickle time once the queue
+         becomes a socket (ROADMAP item 4).
+  GC016  structured-error contract: every `raise` of a registered
+         structured error (analysis/error_contracts.py) must pass each
+         field its class declares required, and only declared fields —
+         a forgotten field fails in the *handler* (supervisor rollback,
+         serving retry math) far from the raise site.
+
+Scope model mirrors pass 1: execution contexts are resolved transitively by
+bare name within the module (`_Module._closure`); cross-module workers are
+out of lexical reach and documented as a scope limit. Suppression uses the
+shared `# graftcheck: disable=GCnnn — justification` machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+import typing as tp
+
+from .error_contracts import ERROR_CONTRACTS
+from .lint import (
+    Finding,
+    _FuncDef,
+    _GC007_LEAVES,
+    _Module,
+    _call_name,
+    _dotted,
+    _unwrap_callable,
+    iter_python_files,
+    parse_suppressions,
+)
+
+CONCURRENCY_RULES: tp.Dict[str, str] = {
+    "GC013": "engine-owned state mutated off the driver execution context",
+    "GC014": "signal handler does more than set a pre-existing flag",
+    "GC015": "non-plain-data value placed into a wire handoff structure",
+    "GC016": "structured error raised without its declared fields",
+}
+
+# Attribute-chain parts that mark driver-owned serving/training state. A
+# dotted chain like `self.engine.temperature` or `router.pool.pages` is
+# engine-owned iff one of these appears as an exact chain part (substring
+# matches would catch `engineering`).
+_CONFINED_PARTS = frozenset(
+    {
+        "engine",
+        "engines",
+        "pool",
+        "trie",
+        "prefix_cache",
+        "scheduler",
+        "allocator",
+    }
+)
+
+# The one blessed method on a confined receiver: the driver's own
+# `await asyncio.to_thread(self.engine.step)` funnel (sampling/server.py).
+_BLESSED_LEAF = "step"
+
+_WorkerScopes = tp.Dict[_FuncDef, str]  # def -> human-readable context
+
+
+def _confined_part(chain: tp.Optional[str]) -> tp.Optional[str]:
+    """The engine-owned chain part of a dotted name, if any."""
+    if not chain:
+        return None
+    for part in chain.split("."):
+        if part in _CONFINED_PARTS:
+            return part
+    return None
+
+
+# ----------------------------------------------------------------------
+# GC013 — thread confinement
+# ----------------------------------------------------------------------
+
+
+def _worker_roots(
+    mod: _Module,
+) -> tp.Iterator[tp.Tuple[ast.AST, str, ast.Call]]:
+    """(callable expr, context label, spawning call) per off-driver entry."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node) or ""
+        leaf = name.split(".")[-1]
+        if leaf == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    yield kw.value, "threading.Thread target", node
+        elif leaf == "Timer":
+            fn_expr: tp.Optional[ast.AST] = (
+                node.args[1] if len(node.args) > 1 else None
+            )
+            for kw in node.keywords:
+                if kw.arg == "function":
+                    fn_expr = kw.value
+            if fn_expr is not None:
+                yield fn_expr, "threading.Timer callback", node
+        elif leaf == "to_thread" and node.args:
+            callee = node.args[0]
+            dotted = _dotted(callee)
+            # The blessed funnel: to_thread(self.engine.step) runs ONE
+            # bound method whose receiver the driver owns; anything else
+            # shipped to the thread pool is a worker context.
+            if dotted and dotted.split(".")[-1] == _BLESSED_LEAF:
+                continue
+            yield callee, "asyncio.to_thread callee", node
+        elif leaf == "run_in_executor" and len(node.args) > 1:
+            dotted = _dotted(node.args[1])
+            if dotted and dotted.split(".")[-1] == _BLESSED_LEAF:
+                continue
+            yield node.args[1], "run_in_executor callee", node
+        # watchdog-style expiry callbacks, by keyword convention
+        for kw in node.keywords:
+            if kw.arg == "on_expire":
+                yield kw.value, "on_expire callback", node
+
+# Awaited-executor contexts where a lexically NESTED callee is the blessed
+# queued-command shape (pass 3's GC010 clean idiom): the awaiting coroutine
+# serializes the nested def, so it runs as the driver's own command, not a
+# free-running worker. Threads/timers/expiry callbacks stay workers even
+# when nested — they genuinely run concurrently with their definer.
+_AWAITED_CTXS = ("asyncio.to_thread callee", "run_in_executor callee")
+
+
+def _worker_scopes(mod: _Module) -> tp.Tuple[_WorkerScopes, tp.List[tp.Tuple[ast.Lambda, str]]]:
+    """Worker defs (transitively closed) plus inline lambda workers."""
+    scopes: _WorkerScopes = {}
+    lambdas: tp.List[tp.Tuple[ast.Lambda, str]] = []
+    for expr, ctx, spawn in _worker_roots(mod):
+        if isinstance(expr, ast.Lambda):
+            lambdas.append((expr, ctx))
+            continue
+        roots = set(mod.resolve_defs(_unwrap_callable(expr)))
+        if ctx in _AWAITED_CTXS:
+            spawner = mod.enclosing_function(spawn)
+            roots = {
+                d for d in roots if mod.enclosing_function(d) is not spawner
+            }
+        for d in mod._closure(roots):
+            scopes.setdefault(d, ctx)
+    return scopes, lambdas
+
+
+def _gc013_violations(
+    mod: _Module, body: ast.AST, where: str, ctx: str
+) -> tp.Iterator[Finding]:
+    for node in ast.walk(body):
+        # (a) stores / deletes / augmented assigns on engine-owned chains
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), (ast.Store, ast.Del)
+        ):
+            chain = _dotted(node)
+            part = _confined_part(chain)
+            # a bare Name store (`pool = ...`) is a local rebind, not a
+            # mutation of shared state — only dotted chains count
+            if part and chain and "." in chain:
+                yield Finding(
+                    "GC013",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    f"`{chain}` is mutated inside {where} ({ctx}) — "
+                    f"`{part}`-owned state is confined to the driver loop; "
+                    "hand results back via a queue/event the driver drains "
+                    "(docs/ANALYSIS.md pass 4)",
+                )
+        # (b) mutating method calls on engine-owned receivers
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            chain = _dotted(node.func)
+            if not chain:
+                continue
+            receiver = ".".join(chain.split(".")[:-1])
+            leaf = chain.split(".")[-1]
+            if _confined_part(receiver) and leaf != _BLESSED_LEAF:
+                yield Finding(
+                    "GC013",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    f"`{chain}()` is called inside {where} ({ctx}) — "
+                    "engine-owned objects may only be driven from the "
+                    "driver loop; enqueue a command instead",
+                )
+
+
+def _rule_gc013(mod: _Module) -> tp.Iterator[Finding]:
+    scopes, lambdas = _worker_scopes(mod)
+    for d, ctx in scopes.items():
+        yield from _gc013_violations(mod, d, f"worker `{d.name}`", ctx)
+    for lam, ctx in lambdas:
+        yield from _gc013_violations(mod, lam.body, "a worker lambda", ctx)
+
+
+# ----------------------------------------------------------------------
+# GC014 — signal-handler safety
+# ----------------------------------------------------------------------
+
+# Synchronization-primitive constructors a handler must never build (the
+# allocation itself can deadlock under a held GIL-adjacent lock, and a
+# fresh primitive in a handler is a design smell regardless).
+_SYNC_CTORS = frozenset(
+    {
+        "Lock",
+        "RLock",
+        "Condition",
+        "Semaphore",
+        "BoundedSemaphore",
+        "Event",
+        "Barrier",
+        "Queue",
+        "SimpleQueue",
+    }
+)
+
+_IO_CALLS = frozenset({"print", "open", "input"})
+_LOG_LEAVES = frozenset(
+    {"debug", "info", "warning", "error", "exception", "critical", "log"}
+)
+
+
+def _is_signal_signal(call: ast.Call) -> bool:
+    name = _call_name(call) or ""
+    parts = name.split(".")
+    return parts[-1] == "signal" and (len(parts) == 1 or parts[-2] == "signal")
+
+
+def _handler_defs(mod: _Module) -> tp.Set[_FuncDef]:
+    roots: tp.Set[_FuncDef] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and _is_signal_signal(node)
+            and len(node.args) > 1
+        ):
+            roots.update(mod.resolve_defs(_unwrap_callable(node.args[1])))
+    return mod._closure(roots)
+
+
+def _gc014_call_problem(node: ast.Call) -> tp.Optional[str]:
+    name = _call_name(node) or ""
+    parts = name.split(".")
+    leaf = parts[-1]
+    if name in _IO_CALLS:
+        return f"`{name}()` performs IO"
+    if leaf in _GC007_LEAVES and len(parts) > 1:
+        return f"`{name}()` is a checkpoint/collective call"
+    if _confined_part(".".join(parts[:-1])):
+        return f"`{name}()` drives engine-owned state"
+    if parts[0] == "logging" or (
+        len(parts) > 1 and parts[0] in ("logger", "log") and leaf in _LOG_LEAVES
+    ):
+        return f"`{name}()` allocates/locks inside the logging machinery"
+    if leaf == "acquire":
+        return f"`{name}()` acquires a lock (deadlocks if the interrupted frame holds it)"
+    if leaf in _SYNC_CTORS:
+        return f"`{name}()` constructs a synchronization primitive"
+    return None
+
+
+def _rule_gc014(mod: _Module) -> tp.Iterator[Finding]:
+    for handler in _handler_defs(mod):
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Call):
+                # blessed one-shot re-arm: signal.signal(signum, previous)
+                # inside the handler restores the prior disposition
+                if _is_signal_signal(node):
+                    continue
+                problem = _gc014_call_problem(node)
+                if problem:
+                    yield Finding(
+                        "GC014",
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"signal handler `{handler.name}`: {problem} — "
+                        "handlers run at an arbitrary bytecode boundary and "
+                        "may only set pre-existing flags "
+                        "(robustness/preempt.py is the pattern)",
+                    )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                yield Finding(
+                    "GC014",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    f"signal handler `{handler.name}` allocates a "
+                    "comprehension — handlers may only set pre-existing "
+                    "flags",
+                )
+
+
+# ----------------------------------------------------------------------
+# GC015 — wire contract for handoff/spill/failover payloads
+# ----------------------------------------------------------------------
+
+# Queue/tier classes whose contents cross (or will cross, ROADMAP item 4)
+# a process boundary, and the item classes that ride them.
+_WIRE_QUEUE_CTORS = frozenset({"PageHandoffQueue", "SpillTier"})
+_WIRE_ITEM_CTORS = frozenset({"HandoffItem", "FailoverItem", "_SpillEntry"})
+_WIRE_CHAIN_HINTS = ("handoff", "failover", "spill")
+
+# The quantized-page wire shape: int8 pages + their dequant scales, nothing
+# else (sampling/disagg.py `_gather_pages` is the blessed producer).
+_BLESSED_BLOCK_KEYS = frozenset({"k", "v", "k_scale", "v_scale"})
+
+# Host-landing calls that terminate the device-array scan: the value is
+# host numpy by construction past this point.
+_HOST_LANDING = frozenset({"asarray", "array"})
+_NP_ROOTS = frozenset({"np", "numpy"})
+_DEVICE_ROOTS = frozenset({"jnp", "jax"})
+
+
+def _wire_queue_chains(mod: _Module) -> tp.Set[str]:
+    """Dotted chains assigned from a wire-queue constructor (self.queue...)."""
+    chains: tp.Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        name = _call_name(node.value) or ""
+        if name.split(".")[-1] not in _WIRE_QUEUE_CTORS:
+            continue
+        for t in node.targets:
+            chain = _dotted(t)
+            if chain:
+                chains.add(chain)
+    return chains
+
+
+def _is_wire_push(node: ast.Call, queue_chains: tp.Set[str]) -> bool:
+    if not (isinstance(node.func, ast.Attribute) and node.func.attr == "push"):
+        return False
+    receiver = _dotted(node.func.value)
+    if receiver is None:
+        return False
+    if receiver in queue_chains:
+        return True
+    low = receiver.lower()
+    return any(h in low for h in _WIRE_CHAIN_HINTS)
+
+
+def _field_problems(expr: ast.AST) -> tp.Iterator[tp.Tuple[ast.AST, str]]:
+    """Scan one wire-item field value for non-plain-data content."""
+
+    def visit(node: ast.AST) -> tp.Iterator[tp.Tuple[ast.AST, str]]:
+        if isinstance(node, ast.Lambda):
+            yield node, "a lambda/closure cannot cross the wire"
+            return
+        if isinstance(node, ast.Call):
+            name = _call_name(node) or ""
+            parts = name.split(".")
+            if parts[0] in _NP_ROOTS and parts[-1] in _HOST_LANDING:
+                return  # host-landed by construction; stop descending
+            if parts[0] in _DEVICE_ROOTS:
+                yield (
+                    node,
+                    f"`{name}(...)` is a device array — land it on host "
+                    "first (`np.asarray(jnp.take(...))`, the "
+                    "`_gather_pages` idiom)",
+                )
+                return
+            # a call RESULT is data; scan only its inputs (so a clock
+            # *read* like `self._clock()` passes while a clock *reference*
+            # in a field fails below)
+            for a in node.args:
+                yield from visit(a)
+            for kw in node.keywords:
+                yield from visit(kw.value)
+            return
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            chain = _dotted(node)
+            if chain:
+                leaf = chain.split(".")[-1].lower()
+                # word-boundary match so `blocks`/`block_size` never trip it
+                if (
+                    leaf in ("lock", "_lock", "rlock", "_rlock", "mutex")
+                    or "_lock" in leaf
+                    or leaf.startswith("lock_")
+                ):
+                    yield node, f"`{chain}` looks like a lock"
+                    return
+                if leaf in ("clock", "_clock"):
+                    yield (
+                        node,
+                        f"`{chain}` is a clock callable — stamp a float "
+                        "(`self._clock()`) instead",
+                    )
+                    return
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+
+    yield from visit(expr)
+
+
+def _bad_block_keys(expr: ast.AST) -> tp.Iterator[tp.Tuple[ast.AST, str]]:
+    """Non-blessed string keys in a dict literal bound to `blocks=`."""
+    if isinstance(expr, ast.Dict):
+        for k in expr.keys:
+            if (
+                isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and k.value not in _BLESSED_BLOCK_KEYS
+            ):
+                yield k, k.value
+
+
+def _check_item_call(mod: _Module, call: ast.Call) -> tp.Iterator[Finding]:
+    for kw in call.keywords:
+        if kw.arg == "blocks":
+            for node, key in _bad_block_keys(kw.value):
+                yield Finding(
+                    "GC015",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    f"block key `{key}` is outside the quantized-page wire "
+                    "shape {k, v, k_scale, v_scale} — the dequant consumer "
+                    "on the far side will not recognize it",
+                )
+        for node, why in _field_problems(kw.value):
+            yield Finding(
+                "GC015",
+                mod.path,
+                node.lineno,
+                node.col_offset,
+                f"wire-item field `{kw.arg or '**'}`: {why}",
+            )
+
+
+def _producer_defs(mod: _Module, queue_chains: tp.Set[str]) -> tp.Set[_FuncDef]:
+    """Functions that construct wire items or push to wire queues."""
+    out: tp.Set[_FuncDef] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node) or ""
+        if name.split(".")[-1] in _WIRE_ITEM_CTORS or _is_wire_push(
+            node, queue_chains
+        ):
+            fn = mod.enclosing_function(node)
+            if fn is not None:
+                out.add(fn)
+    return out
+
+
+def _rule_gc015(mod: _Module) -> tp.Iterator[Finding]:
+    queue_chains = _wire_queue_chains(mod)
+    checked: tp.Set[ast.Call] = set()
+
+    # 1) every wire-item constructor call, wherever it appears
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = _call_name(node) or ""
+            if name.split(".")[-1] in _WIRE_ITEM_CTORS:
+                checked.add(node)
+                yield from _check_item_call(mod, node)
+
+    # 2) direct `queue.push(<expr>)` arguments: a constructor call gets the
+    #    field check; a Name is traced one hop to its producing assignment
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_wire_push(node, queue_chains)):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Call) and arg not in checked:
+                checked.add(arg)
+                yield from _check_item_call(mod, arg)
+            elif isinstance(arg, (ast.Lambda,)):
+                yield Finding(
+                    "GC015",
+                    mod.path,
+                    arg.lineno,
+                    arg.col_offset,
+                    "a lambda pushed into a wire queue cannot cross the wire",
+                )
+
+    # 3) inside producer functions, `blocks[...] = value` stores must use
+    #    blessed keys and host-landed values
+    for fn in _producer_defs(mod, queue_chains):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "blocks"
+                ):
+                    continue
+                key = t.slice
+                if (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value not in _BLESSED_BLOCK_KEYS
+                ):
+                    yield Finding(
+                        "GC015",
+                        mod.path,
+                        t.lineno,
+                        t.col_offset,
+                        f"block key `{key.value}` is outside the "
+                        "quantized-page wire shape {k, v, k_scale, v_scale}",
+                    )
+                for sub, why in _field_problems(node.value):
+                    yield Finding(
+                        "GC015",
+                        mod.path,
+                        sub.lineno,
+                        sub.col_offset,
+                        f"wire block store: {why}",
+                    )
+
+
+# ----------------------------------------------------------------------
+# GC016 — structured-error raise contract
+# ----------------------------------------------------------------------
+
+
+def _rule_gc016(mod: _Module) -> tp.Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Raise) or not isinstance(node.exc, ast.Call):
+            continue
+        call = node.exc
+        name = _call_name(call) or ""
+        leaf = name.split(".")[-1]
+        contract = ERROR_CONTRACTS.get(leaf)
+        if contract is None:
+            continue
+        if any(kw.arg is None for kw in call.keywords):
+            continue  # **splat: not statically checkable
+        passed = {kw.arg for kw in call.keywords}
+        missing = [f for f in contract.required if f not in passed]
+        declared = set(contract.required) | set(contract.optional)
+        undeclared = sorted(passed - declared)
+        if len(call.args) > 1:
+            yield Finding(
+                "GC016",
+                mod.path,
+                call.lineno,
+                call.col_offset,
+                f"`{leaf}` takes its structured fields keyword-only — "
+                "positional args beyond the message will TypeError at "
+                "raise time",
+            )
+        if missing:
+            yield Finding(
+                "GC016",
+                mod.path,
+                call.lineno,
+                call.col_offset,
+                f"`raise {leaf}` is missing required field(s) "
+                f"{missing} declared in analysis/error_contracts.py — "
+                "the handler that unpacks this error will read garbage",
+            )
+        if undeclared:
+            yield Finding(
+                "GC016",
+                mod.path,
+                call.lineno,
+                call.col_offset,
+                f"`raise {leaf}` passes undeclared field(s) {undeclared} — "
+                "not in the class contract (typo, or update "
+                "analysis/error_contracts.py with the class)",
+            )
+
+
+_ALL_RULES = (_rule_gc013, _rule_gc014, _rule_gc015, _rule_gc016)
+
+
+# ----------------------------------------------------------------------
+# driver — mirrors lint_source / lint_paths
+# ----------------------------------------------------------------------
+
+
+def concurrency_source(
+    source: str,
+    path: str = "<string>",
+    rules: tp.Optional[tp.Iterable[str]] = None,
+) -> tp.Tuple[tp.List[Finding], tp.List[Finding]]:
+    """Run pass 4 on one module's source. Returns (active, suppressed).
+
+    Syntax errors yield nothing — pass 1 already reports GC000 for the
+    same file."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return [], []
+    mod = _Module(path, source, tree)
+    wanted = set(rules) if rules is not None else set(CONCURRENCY_RULES)
+    suppress_at: tp.Dict[int, tp.Set[str]] = {}
+    for s in parse_suppressions(source):
+        suppress_at.setdefault(s.line, set()).update(s.rules)
+    active: tp.List[Finding] = []
+    suppressed: tp.List[Finding] = []
+    for rule_fn in _ALL_RULES:
+        for f in rule_fn(mod):
+            if f.rule not in wanted:
+                continue
+            if f.rule in suppress_at.get(f.line, ()):
+                suppressed.append(f)
+            else:
+                active.append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return active, suppressed
+
+
+def concurrency_paths(
+    paths: tp.Sequence[str],
+    rules: tp.Optional[tp.Iterable[str]] = None,
+) -> tp.Tuple[tp.List[Finding], tp.List[Finding], int]:
+    """Run pass 4 over files/trees. Returns (active, suppressed, n_files)."""
+    active: tp.List[Finding] = []
+    suppressed: tp.List[Finding] = []
+    n = 0
+    for path in iter_python_files(paths):
+        n += 1
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        a, s = concurrency_source(src, path, rules)
+        active.extend(a)
+        suppressed.extend(s)
+    return active, suppressed, n

@@ -1,0 +1,76 @@
+"""Declarative field contracts for the structured error types (GC016).
+
+The robustness and serving layers communicate failure through structured
+exceptions — the supervisor reads ``DivergenceError.last_good_step`` to pick
+a rollback target, the serving front door reads ``BackpressureError``'s page
+accounting to compute a retry delay, chaos gates match on
+``PoolResizeError.retryable``. A raise that forgets a field does not fail at
+the raise site; it fails much later, in whatever handler reaches for the
+missing attribute — usually inside a chaos run where the traceback points at
+the *recovery* path, not the bug.
+
+GC016 (analysis/concurrency.py) makes the contract lexical: every ``raise``
+of a registered error must pass each field marked required below, and may
+pass only fields the class declares. This module is the single place that
+registers contracts — like ``budgets.py``, it is a reviewed manifest, not
+configuration, and it must stay importable without jax (the analysis pass
+runs in a JAX-free interpreter).
+
+Keep entries in sync with the constructor signatures in
+``robustness/errors.py``, ``sampling/ops.py``, ``sampling/serve.py``, and
+``sampling/disagg.py`` — ``tests/test_graftcheck.py`` pins the registry
+against the live classes via ``inspect.signature``.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+
+class ErrorContract(tp.NamedTuple):
+    """Field contract for one structured error class.
+
+    ``required``: keyword fields every raise must pass explicitly (no
+    defaults worth relying on — an absent value means the handler gets a
+    lie, not a placeholder). ``optional``: declared fields a raise may
+    pass. Anything else is a typo'd/undeclared keyword and is flagged.
+    The positional message argument is outside the contract.
+    """
+
+    required: tp.Tuple[str, ...]
+    optional: tp.Tuple[str, ...] = ()
+
+
+# Keyed by bare class name: graftcheck resolves `raise X(...)` by the dotted
+# leaf, the same bare-name discipline as pass 1 (imports are flattened by
+# the AST walk; none of these names collide across modules).
+ERROR_CONTRACTS: tp.Dict[str, ErrorContract] = {
+    # robustness/errors.py
+    "DivergenceError": ErrorContract(
+        required=("step",), optional=("last_good_step", "rundir")
+    ),
+    "StepHangError": ErrorContract(
+        required=("waited_s", "rundir"), optional=("step",)
+    ),
+    "CheckpointCorruptError": ErrorContract(
+        required=("step",), optional=("problems",)
+    ),
+    "CheckpointWriteError": ErrorContract(
+        required=("step", "attempts"), optional=("directory",)
+    ),
+    # sampling/ops.py
+    "HotSwapError": ErrorContract(
+        required=("reason",), optional=("path", "expected", "got")
+    ),
+    "PoolResizeError": ErrorContract(
+        required=("requested_pages", "resident_pages", "num_pages"),
+        optional=("requested_slots", "live_slots", "retryable"),
+    ),
+    # sampling/serve.py — `retry_after_pages` is a derived property, NOT a
+    # constructor field; listing it here would bless a TypeError.
+    "BackpressureError": ErrorContract(
+        required=("needed_pages", "backlog_pages", "budget_pages", "retryable")
+    ),
+    # sampling/disagg.py
+    "HandoffRetryExhausted": ErrorContract(required=("uid", "attempts")),
+}

@@ -1,0 +1,334 @@
+"""Committed jit-surface manifest: a static census of every jit wrapper.
+
+Each jit wrapper in the tree is one compile surface: its `static_argnums`/
+`static_argnames` multiply compiled-program count by the static domain size,
+and its `donate_argnums` are load-bearing aliasing contracts (GC004). Today
+that surface only grows by diff review luck; this module makes it a reviewed
+artifact the way findings already are — `python -m midgpt_tpu.analysis
+--fail-on-new` diffs the live census against the committed
+`jit_surface_baseline.json`, so a new jit wrapper, a widened static-arg set,
+or a regressed GC011 boundedness verdict fails CI until the baseline is
+deliberately updated (`--update-baseline`).
+
+Census entries are keyed (module path, wrapper name) — line-number-free like
+the findings baseline, so pure code motion never churns the manifest. Three
+wrapper forms are recognized, mirroring pass 1/3's scope model:
+
+  decorator  `@jax.jit` / `@jax.jit(...)` / `@functools.partial(jax.jit, …)`
+  rebinding  `name = jax.jit(fn, ...)` (any scope; `name` is the key)
+  inline     any other `jax.jit(...)` call, e.g. immediately invoked —
+             keyed `<inline:lambda#0>` with a per-module occurrence counter
+
+Per static argument the manifest records the GC011 domain verdict, computed
+with pass 3's cross-module `_BoundProver`: "bounded" (every bare-name
+callsite's value provably draws from a finite domain), "unproven" (at least
+one callsite the prover cannot bound — including GC011-suppressed sites:
+the suppression silences the finding, not the census), or "uncalled" (no
+bare-name callsite in the scanned tree). JAX-free, like every pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import typing as tp
+
+from .lifecycle import _BoundProver, _Index, _ModuleInfo
+from .lint import (
+    _FuncDef,
+    _call_name,
+    _is_jax_jit,
+    _partial_of,
+    _unwrap_callable,
+    iter_python_files,
+)
+
+JIT_SURFACE_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "jit_surface_baseline.json"
+)
+
+
+def _int_tuple(v: ast.AST) -> tp.Tuple[int, ...]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return (v.value,)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in v.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _str_tuple(v: ast.AST) -> tp.Tuple[str, ...]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return (v.value,)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in v.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _wrapper_opts(call: tp.Optional[ast.Call]) -> tp.Dict[str, tp.Tuple]:
+    """static/donate options off the jit (or partial-of-jit) call."""
+    out: tp.Dict[str, tp.Tuple] = {
+        "static_argnums": (),
+        "static_argnames": (),
+        "donate_argnums": (),
+    }
+    if call is None:
+        return out
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            out["static_argnums"] = _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            out["static_argnames"] = _str_tuple(kw.value)
+        elif kw.arg in ("donate_argnums", "donate_argnames"):
+            out["donate_argnums"] = _int_tuple(kw.value)
+    return out
+
+
+def _jit_decorator_call(deco: ast.AST) -> tp.Optional[tp.Tuple[bool, tp.Optional[ast.Call]]]:
+    """(is_jit, options-bearing call) for one decorator expression."""
+    if _is_jax_jit(deco):
+        return True, None  # bare @jax.jit
+    if isinstance(deco, ast.Call):
+        inner = _partial_of(deco)
+        if inner is not None and _is_jax_jit(inner):
+            return True, deco  # @functools.partial(jax.jit, ...)
+        if _is_jax_jit(deco.func):
+            return True, deco  # @jax.jit(...)
+    return None
+
+
+def _static_indices(
+    opts: tp.Dict[str, tp.Tuple], fn: tp.Optional[_FuncDef]
+) -> tp.List[tp.Tuple[int, str]]:
+    """(positional index, display name) per static argument."""
+    params = [a.arg for a in fn.args.args] if fn is not None else []
+    out: tp.List[tp.Tuple[int, str]] = []
+    for i in opts["static_argnums"]:
+        name = params[i] if i < len(params) else str(i)
+        out.append((i, name))
+    for pname in opts["static_argnames"]:
+        if pname in params:
+            out.append((params.index(pname), pname))
+    return out
+
+
+def _verdicts(
+    wrapper_name: str,
+    fn: tp.Optional[_FuncDef],
+    opts: tp.Dict[str, tp.Tuple],
+    modules: tp.List[_ModuleInfo],
+    prover: _BoundProver,
+) -> tp.Dict[str, str]:
+    """GC011 boundedness verdict per static arg, across all modules'
+    bare-name callsites of the wrapper."""
+    statics = _static_indices(opts, fn)
+    if not statics:
+        return {}
+    verdicts: tp.Dict[str, str] = {}
+    params = [a.arg for a in fn.args.args] if fn is not None else []
+    for i, display in statics:
+        n_sites = 0
+        all_bounded = True
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == wrapper_name
+                ):
+                    continue
+                if fn is not None and mod.enclosing_function(node) is fn:
+                    continue  # recursion, not a callsite
+                n_sites += 1
+                arg_expr: tp.Optional[ast.expr] = None
+                if i < len(node.args):
+                    arg_expr = node.args[i]
+                elif i < len(params):
+                    for kw in node.keywords:
+                        if kw.arg == params[i]:
+                            arg_expr = kw.value
+                if arg_expr is None:
+                    continue  # defaulted: the literal default is bounded
+                if not prover.bounded(arg_expr, mod, mod.enclosing_function(node)):
+                    all_bounded = False
+        if n_sites == 0:
+            verdicts[display] = "uncalled"
+        else:
+            verdicts[display] = "bounded" if all_bounded else "unproven"
+    return verdicts
+
+
+def jit_surface(
+    paths: tp.Sequence[str], rel_to: tp.Optional[str] = None
+) -> tp.List[tp.Dict[str, tp.Any]]:
+    """Static census of every jit wrapper under `paths`, sorted by
+    (path, name). `rel_to` relativizes entry paths (the repo root in CLI
+    use) so the committed baseline is machine-independent."""
+    sources: tp.List[tp.Tuple[str, str]] = []
+    modules: tp.List[_ModuleInfo] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            modules.append(_ModuleInfo(path, ast.parse(src)))
+            sources.append((path, src))
+        except SyntaxError:
+            continue  # pass 1 reports GC000 for this file
+    prover = _BoundProver(_Index(modules))
+
+    entries: tp.List[tp.Dict[str, tp.Any]] = []
+
+    def rel(path: str) -> str:
+        if rel_to:
+            try:
+                return os.path.relpath(path, rel_to).replace(os.sep, "/")
+            except ValueError:
+                pass
+        return path.replace(os.sep, "/")
+
+    def add(
+        mod: _ModuleInfo,
+        name: str,
+        form: str,
+        opts: tp.Dict[str, tp.Tuple],
+        fn: tp.Optional[_FuncDef],
+    ) -> None:
+        entries.append(
+            {
+                "path": rel(mod.path),
+                "name": name,
+                "form": form,
+                "static_argnums": sorted(opts["static_argnums"]),
+                "static_argnames": sorted(opts["static_argnames"]),
+                "donate_argnums": sorted(opts["donate_argnums"]),
+                "static_verdicts": _verdicts(name, fn, opts, modules, prover),
+            }
+        )
+
+    for mod in modules:
+        consumed: tp.Set[ast.Call] = set()
+        # 1) decorator form
+        for defs in mod.defs_by_name.values():
+            for d in defs:
+                for deco in d.decorator_list:
+                    hit = _jit_decorator_call(deco)
+                    if hit is None:
+                        continue
+                    _is_jit, opt_call = hit
+                    if isinstance(opt_call, ast.Call):
+                        consumed.add(opt_call)
+                    cls = mod.enclosing_class(d)
+                    name = f"{cls.name}.{d.name}" if cls is not None else d.name
+                    add(mod, name, "decorator", _wrapper_opts(opt_call), d)
+        # 2) `name = jax.jit(fn, ...)` rebinding (any scope)
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_jax_jit(node.value.func)
+                and node.value.args
+            ):
+                continue
+            call = node.value
+            consumed.add(call)
+            target_names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not target_names:
+                target_names = ["<unnamed>"]
+            wrapped = _unwrap_callable(call.args[0])
+            fn: tp.Optional[_FuncDef] = None
+            if wrapped:
+                defs = mod.defs_by_name.get(wrapped.split(".")[-1], [])
+                fn = defs[0] if defs else None
+            for tname in target_names:
+                add(mod, tname, "rebinding", _wrapper_opts(call), fn)
+        # 3) every other jit call: inline, keyed by occurrence order
+        counter = 0
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _is_jax_jit(node.func)
+                and node not in consumed
+            ):
+                continue
+            wrapped_leaf = "lambda"
+            if node.args and not isinstance(node.args[0], ast.Lambda):
+                wrapped = _unwrap_callable(node.args[0])
+                if wrapped:
+                    wrapped_leaf = wrapped.split(".")[-1]
+            add(
+                mod,
+                f"<inline:{wrapped_leaf}#{counter}>",
+                "inline",
+                _wrapper_opts(node),
+                None,
+            )
+            counter += 1
+
+    entries.sort(key=lambda e: (e["path"], e["name"]))
+    # duplicate (path, name) keys — e.g. two same-named defs — get a
+    # stable ordinal suffix so the baseline diff stays keyable
+    seen: tp.Dict[tp.Tuple[str, str], int] = {}
+    for e in entries:
+        key = (e["path"], e["name"])
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        if n:
+            e["name"] = f"{e['name']}#{n + 1}"
+    return entries
+
+
+def load_baseline(path: str = JIT_SURFACE_BASELINE_PATH) -> tp.List[tp.Dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_baseline(
+    entries: tp.List[tp.Dict], path: str = JIT_SURFACE_BASELINE_PATH
+) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def diff_surface(
+    current: tp.List[tp.Dict], baseline: tp.List[tp.Dict]
+) -> tp.List[str]:
+    """Human-readable problems: wrappers that are new or changed relative
+    to the committed baseline. Removals are allowed (shrinking the compile
+    surface needs no ceremony); `--update-baseline` re-pins them away."""
+    base = {(e["path"], e["name"]): e for e in baseline}
+    problems: tp.List[str] = []
+    for e in current:
+        key = (e["path"], e["name"])
+        pinned = base.get(key)
+        if pinned is None:
+            problems.append(
+                f"new jit wrapper `{e['name']}` in {e['path']} "
+                "(not in jit_surface_baseline.json — review, then "
+                "--update-baseline)"
+            )
+            continue
+        for field in (
+            "form",
+            "static_argnums",
+            "static_argnames",
+            "donate_argnums",
+            "static_verdicts",
+        ):
+            if e.get(field) != pinned.get(field):
+                problems.append(
+                    f"jit wrapper `{e['name']}` in {e['path']} changed "
+                    f"{field}: baseline {pinned.get(field)!r} -> "
+                    f"current {e.get(field)!r}"
+                )
+    return problems

@@ -71,7 +71,27 @@ class CheckpointCorruptError(ValueError):
 
 
 class CheckpointWriteError(OSError):
-    """A checkpoint save still failed after the configured retry budget."""
+    """A checkpoint save still failed after the configured retry budget.
+
+    `step` is the step whose save was abandoned, `attempts` the retry
+    budget that was exhausted (training/checkpoint.py `write_retries`),
+    and `directory` the checkpoint root — the fields the supervisor's
+    emergency-save path and the chaos gate (`ckpt_enospc*2`) report
+    without re-parsing the message.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        step: int,
+        attempts: int,
+        directory: str = "",
+    ):
+        super().__init__(message)
+        self.step = step
+        self.attempts = attempts
+        self.directory = directory
 
 
 class SimulatedPreemption(BaseException):

@@ -332,7 +332,10 @@ class CheckpointManager:
             self._clear_partial(step)
             raise CheckpointWriteError(
                 f"checkpoint save at step {step} under {self._dir} failed "
-                f"{self.write_retries} attempt(s); last error: {e}"
+                f"{self.write_retries} attempt(s); last error: {e}",
+                step=step,
+                attempts=self.write_retries,
+                directory=str(self._dir),
             ) from e
         if faults.should_fire("kill_mid_save", step=step):
             # Model SIGKILL between the TensorStore write and the manifest

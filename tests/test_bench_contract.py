@@ -14,6 +14,7 @@ import pytest
 
 from midgpt_tpu.analysis.bench_contract import (
     check_bench_stdout,
+    check_graftcheck,
     check_serve_bench,
     check_serve_fleet_bench,
     check_serve_longctx_bench,
@@ -521,7 +522,7 @@ def test_bench_train_emits_conformant_json_line(capsys):
 def test_graftcheck_cli_emits_conformant_json_line(capsys, tmp_path):
     """tools/graftcheck.py --json through the SAME in-process harness as
     the benches: its line must satisfy the graftcheck profile, including
-    the pass-3 stats fields."""
+    the pass-3/pass-4 stats fields and the jit-surface census count."""
     p = tmp_path / "clean.py"
     p.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x + 1\n")
     out = _run_entry_point(
@@ -534,6 +535,8 @@ def test_graftcheck_cli_emits_conformant_json_line(capsys, tmp_path):
     assert rec["tool"] == "graftcheck"
     assert rec["count"] == 0 and rec["files_scanned"] == 1
     assert rec["pass3_count"] == 0 and rec["pass3_wall_ms"] >= 0
+    assert rec["pass4_count"] == 0 and rec["pass4_wall_ms"] >= 0
+    assert rec["jit_surface_count"] == 1  # the @jax.jit wrapper above
 
 
 # ----------------------------------------------------------------------
@@ -554,6 +557,35 @@ def test_checker_rejects_nan():
     line = json.dumps({"metric": "m", "value": float("nan")}) + "\n"
     rec, problems = parse_single_json_line(line)
     assert rec is None and any("NaN" in p or "non-finite" in p for p in problems)
+
+
+def test_graftcheck_checker_catches_pass4_field_drift():
+    """The graftcheck profile holds on a synthetic record without running
+    the CLI: dropping or mistyping any pass-4 / jit-surface stat field is
+    a contract violation, not a number."""
+    good = {
+        "tool": "graftcheck", "count": 0, "suppressed": 0,
+        "files_scanned": 1, "findings": [],
+        "pass3_count": 0, "pass3_suppressed": 0, "pass3_wall_ms": 1.0,
+        "pass4_count": 0, "pass4_suppressed": 0, "pass4_wall_ms": 1.0,
+        "jit_surface_count": 3,
+    }
+    assert check_graftcheck(good) == []
+    for field in (
+        "pass4_count",
+        "pass4_suppressed",
+        "pass4_wall_ms",
+        "jit_surface_count",
+    ):
+        missing = dict(good)
+        missing.pop(field)
+        assert any(field in p for p in check_graftcheck(missing)), field
+    wrong_type = dict(good, pass4_count="0")
+    assert any("pass4_count" in p for p in check_graftcheck(wrong_type))
+    assert any(
+        "jit_surface_count" in p
+        for p in check_graftcheck(dict(good, jit_surface_count=2.5))
+    )
 
 
 def test_checker_catches_field_drift():

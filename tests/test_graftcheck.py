@@ -1,11 +1,11 @@
-"""graftcheck pass-1 lint + pass-3 lifecycle: one deliberate-violation
-fixture per rule (GC001-GC011; path-scoped GC012 gets dedicated tests
-below — it cannot live in FIXTURES because it only fires under
-`sampling/` / `robustness/` paths), suppression semantics, and the CLI
-contract (nonzero exit with rule ID + file:line on violations; --json is
-one schema-conformant line; --fail-on-new gates on the committed
-baseline). The repo-wide "tree is clean" gate lives in
-tests/test_lint_clean.py.
+"""graftcheck pass-1 lint + pass-3 lifecycle + pass-4 concurrency: one
+deliberate-violation fixture per rule (GC001-GC011, GC013-GC016;
+path-scoped GC012 gets dedicated tests below — it cannot live in FIXTURES
+because it only fires under `sampling/` / `robustness/` paths),
+suppression semantics, the jit-surface census/diff, and the CLI contract
+(nonzero exit with rule ID + file:line on violations; --json is one
+schema-conformant line; --fail-on-new gates on the committed baselines).
+The repo-wide "tree is clean" gate lives in tests/test_lint_clean.py.
 """
 
 import json
@@ -16,17 +16,20 @@ import sys
 import pytest
 
 from midgpt_tpu.analysis.bench_contract import check_bench_stdout
+from midgpt_tpu.analysis.concurrency import concurrency_source
+from midgpt_tpu.analysis.jit_surface import diff_surface, jit_surface
 from midgpt_tpu.analysis.lifecycle import lifecycle_source
 from midgpt_tpu.analysis.lint import lint_source, parse_suppressions
 
 
 def check_source(src, path):
-    """Both JAX-free passes merged — every fixture must trip exactly its
-    own rule and stay clean under the other pass."""
+    """All three JAX-free passes merged — every fixture must trip exactly
+    its own rule and stay clean under the other passes."""
     active, suppressed = lint_source(src, path)
     a3, s3 = lifecycle_source(src, path)
-    merged = sorted(active + a3, key=lambda f: (f.line, f.col, f.rule))
-    return merged, suppressed + s3
+    a4, s4 = concurrency_source(src, path)
+    merged = sorted(active + a3 + a4, key=lambda f: (f.line, f.col, f.rule))
+    return merged, suppressed + s3 + s4
 
 # One minimal violating snippet per rule; (rule, expected line) is asserted
 # exactly so a rule that silently stops firing fails loudly here.
@@ -161,6 +164,52 @@ def drive(x, requests):
     return x
 """,
         11,
+    ),
+    # thread-escape mutation of engine-owned state
+    "GC013": (
+        """\
+import threading
+
+class Serve:
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        self.engine.temperature = 0.0
+""",
+        8,
+    ),
+    # allocating (IO-performing) signal handler
+    "GC014": (
+        """\
+import signal
+
+def _on_term(signum, frame):
+    with open("/tmp/flag", "w") as fh:
+        fh.write("x")
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+""",
+        4,
+    ),
+    # a lock riding a handoff payload
+    "GC015": (
+        """\
+class Disagg:
+    def enqueue(self, uid):
+        item = HandoffItem(uid=uid, lock=self._lock)
+        self.handoff_queue.push(item)
+""",
+        3,
+    ),
+    # structured error raised without its declared fields
+    "GC016": (
+        """\
+def give_up(step):
+    raise CheckpointWriteError(f"save at {step} failed")
+""",
+        2,
     ),
 }
 
@@ -490,6 +539,315 @@ class Engine:
 
 
 # ----------------------------------------------------------------------
+# Pass 4: clean counterparts and extra triggering shapes
+# ----------------------------------------------------------------------
+
+
+def test_gc013_queued_command_worker_is_clean():
+    """The blessed worker shape: results travel back through driver-owned
+    queues/events; the worker never touches engine state directly."""
+    src = """\
+import threading
+
+class Serve:
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        self._cmds.append(("set_temperature", 0.0))
+        self._landed.set()
+"""
+    active, _ = check_source(src, "srv.py")
+    assert active == []
+
+
+def test_gc013_blessed_to_thread_step_funnel_passes_others_flag():
+    """`await asyncio.to_thread(self.engine.step)` is the ONE blessed
+    off-loop engine touch (sampling/server.py driver); shipping any other
+    callee to the thread pool makes it a worker context."""
+    ok = """\
+import asyncio
+
+class Server:
+    async def drive(self):
+        await asyncio.to_thread(self.engine.step)
+"""
+    active, _ = check_source(ok, "ok.py")
+    assert active == []
+    bad = """\
+import asyncio
+
+class Server:
+    async def drive(self):
+        await asyncio.to_thread(self._drain)
+
+    def _drain(self):
+        self.pool.resize(4)
+"""
+    active, _ = check_source(bad, "bad.py")
+    assert [(f.rule, f.line) for f in active] == [("GC013", 8)]
+
+
+def test_gc013_on_expire_callback_is_a_worker_context():
+    src = """\
+class Train:
+    def arm(self, wd):
+        wd.sync(self._force, on_expire=self._expired)
+
+    def _expired(self, step, waited):
+        self.engine.abort()
+"""
+    active, _ = check_source(src, "wd.py")
+    assert [(f.rule, f.line) for f in active] == [("GC013", 6)]
+
+
+def test_gc014_one_shot_flag_handler_is_clean():
+    """The robustness/preempt.py pattern: set pre-existing module flags,
+    stamp via an injected clock parameter, restore the previous
+    disposition one-shot — all blessed."""
+    src = """\
+import signal
+
+_requested = False
+
+
+def _on_term(signum, frame, _clock=None):
+    global _requested
+    _requested = True
+    stamp = _clock() if _clock else None
+    signal.signal(signum, signal.SIG_DFL)
+    return stamp
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+"""
+    active, _ = check_source(src, "preempt_ok.py")
+    assert active == []
+
+
+def test_gc014_checkpoint_call_and_lock_in_handler_flag():
+    src = """\
+import signal
+
+def _on_term(signum, frame):
+    mngr.save(0, state)
+    guard.acquire()
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+"""
+    active, _ = check_source(src, "preempt_bad.py")
+    assert [(f.rule, f.line) for f in active] == [("GC014", 4), ("GC014", 5)]
+    assert "checkpoint" in active[0].message
+    assert "lock" in active[1].message
+
+
+def test_gc015_quantized_page_tuple_is_clean():
+    """The `_gather_pages` idiom (sampling/disagg.py): host-landed
+    np.asarray pages under the blessed {k, v, k_scale, v_scale} keys and
+    plain scalars everywhere else."""
+    src = """\
+import jax.numpy as jnp
+import numpy as np
+
+class Disagg:
+    def gather(self, cache, idx, uid):
+        blocks = {}
+        blocks["k"] = np.asarray(jnp.take(cache.k, idx, axis=2))
+        blocks["k_scale"] = np.asarray(jnp.take(cache.k_scale, idx, axis=2))
+        item = HandoffItem(uid=uid, deadline=self._clock() + 1.0,
+                           blocks=blocks, n_pages=2)
+        self.handoff_queue.push(item)
+"""
+    active, _ = check_source(src, "disagg_ok.py")
+    assert active == []
+
+
+def test_gc015_device_array_and_bad_block_key_flag():
+    src = """\
+import jax.numpy as jnp
+
+class Disagg:
+    def gather(self, cache, idx, uid):
+        blocks = {}
+        blocks["k"] = jnp.take(cache.k, idx, axis=2)
+        blocks["raw_logits"] = cache.logits
+        self.handoff_queue.push(HandoffItem(uid=uid, blocks=blocks))
+"""
+    active, _ = check_source(src, "disagg_bad.py")
+    assert [(f.rule, f.line) for f in active] == [
+        ("GC015", 6),
+        ("GC015", 7),
+    ]
+    assert "device array" in active[0].message
+    assert "raw_logits" in active[1].message
+
+
+def test_gc015_tracks_queue_constructor_assignment():
+    """A queue bound from PageHandoffQueue(...) is a wire queue even when
+    the attribute name carries no handoff/failover/spill hint."""
+    src = """\
+class Disagg:
+    def __init__(self):
+        self.queue = PageHandoffQueue(retries=3)
+
+    def enqueue(self, uid):
+        self.queue.push(HandoffItem(uid=uid, clock=self._clock))
+"""
+    active, _ = check_source(src, "q.py")
+    assert [(f.rule, f.line) for f in active] == [("GC015", 6)]
+    assert "clock callable" in active[0].message
+
+
+def test_gc016_complete_raise_is_clean_undeclared_field_flags():
+    ok = """\
+def give_up(step, retries, d):
+    raise CheckpointWriteError(
+        f"save at {step} failed",
+        step=step,
+        attempts=retries,
+        directory=d,
+    )
+"""
+    active, _ = check_source(ok, "ok.py")
+    assert active == []
+    bad = """\
+def shed(self, needed):
+    raise BackpressureError(
+        "no pages",
+        needed_pages=needed,
+        backlog_pages=0,
+        budget_pages=1,
+        retryable=True,
+        retry_after_pages=needed,
+    )
+"""
+    active, _ = check_source(bad, "bad.py")
+    assert [(f.rule) for f in active] == ["GC016"]
+    assert "retry_after_pages" in active[0].message
+
+
+def test_gc016_registry_matches_live_class_signatures():
+    """The declarative registry (analysis/error_contracts.py) must track
+    the real constructors: every declared field is a keyword parameter of
+    the class __init__, required fields have no default, optional fields
+    do. A registry/class drift fails here, not at triage time."""
+    import inspect
+
+    from midgpt_tpu.analysis.error_contracts import ERROR_CONTRACTS
+    from midgpt_tpu.robustness.errors import (
+        CheckpointCorruptError,
+        CheckpointWriteError,
+        DivergenceError,
+        StepHangError,
+    )
+    from midgpt_tpu.sampling.disagg import HandoffRetryExhausted
+    from midgpt_tpu.sampling.ops import HotSwapError, PoolResizeError
+    from midgpt_tpu.sampling.serve import BackpressureError
+
+    classes = {
+        "DivergenceError": DivergenceError,
+        "StepHangError": StepHangError,
+        "CheckpointCorruptError": CheckpointCorruptError,
+        "CheckpointWriteError": CheckpointWriteError,
+        "HotSwapError": HotSwapError,
+        "PoolResizeError": PoolResizeError,
+        "BackpressureError": BackpressureError,
+        "HandoffRetryExhausted": HandoffRetryExhausted,
+    }
+    assert set(classes) == set(ERROR_CONTRACTS)
+    for name, cls in classes.items():
+        contract = ERROR_CONTRACTS[name]
+        params = inspect.signature(cls.__init__).parameters
+        for field in contract.required + contract.optional:
+            assert field in params, f"{name}: `{field}` not a constructor param"
+        declared = set(contract.required) | set(contract.optional)
+        for pname, p in params.items():
+            if pname in ("self", "message") or p.kind is not p.KEYWORD_ONLY:
+                continue
+            assert pname in declared, f"{name}: `{pname}` missing from registry"
+
+
+# ----------------------------------------------------------------------
+# jit-surface census + baseline diff
+# ----------------------------------------------------------------------
+
+
+_SURFACE_SRC = """\
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def step(x, n):
+    return x * n
+
+
+def plain(x):
+    return x + 1
+
+
+fwd = jax.jit(plain)
+params = jax.jit(lambda k: k * 2)(3)
+
+
+def drive(x):
+    return step(x, 1 if x.ndim > 1 else 2)
+"""
+
+
+def _census(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(_SURFACE_SRC)
+    return jit_surface([str(tmp_path)], rel_to=str(tmp_path))
+
+
+def test_jit_surface_census_records_all_three_forms(tmp_path):
+    entries = {e["name"]: e for e in _census(tmp_path)}
+    assert set(entries) == {"step", "fwd", "<inline:lambda#0>"}
+    assert entries["step"]["form"] == "decorator"
+    assert entries["step"]["static_argnums"] == [1]
+    assert entries["step"]["donate_argnums"] == [0]
+    # the only callsite passes a literal-menu IfExp: provably bounded
+    assert entries["step"]["static_verdicts"] == {"n": "bounded"}
+    assert entries["fwd"]["form"] == "rebinding"
+    assert entries["<inline:lambda#0>"]["form"] == "inline"
+
+
+def test_jit_surface_diff_flags_new_and_changed_allows_removed(tmp_path):
+    entries = _census(tmp_path)
+    assert diff_surface(entries, entries) == []
+    # a brand-new wrapper fails until re-pinned
+    missing_one = [e for e in entries if e["name"] != "fwd"]
+    problems = diff_surface(entries, missing_one)
+    assert any("new jit wrapper `fwd`" in p for p in problems)
+    # a widened static set on a pinned wrapper fails
+    import copy
+
+    widened = copy.deepcopy(entries)
+    for e in widened:
+        if e["name"] == "step":
+            e["static_argnums"] = [1, 2]
+    problems = diff_surface(widened, entries)
+    assert any("static_argnums" in p for p in problems)
+    # removal is allowed (shrinking the compile surface needs no ceremony)
+    assert diff_surface(missing_one, entries) == []
+
+
+def test_jit_surface_verdict_degrades_on_unbounded_callsite(tmp_path):
+    src = _SURFACE_SRC.replace(
+        "    return step(x, 1 if x.ndim > 1 else 2)",
+        "    return step(x, x.tolist().pop())",
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    entries = {e["name"]: e for e in jit_surface([str(p)])}
+    assert entries["step"]["static_verdicts"] == {"n": "unproven"}
+
+
+# ----------------------------------------------------------------------
 # CLI contract
 # ----------------------------------------------------------------------
 
@@ -549,6 +907,35 @@ def test_cli_rules_subset_can_select_pass3_only(tmp_path):
     assert not problems, problems
     assert [f["rule"] for f in rec["findings"]] == ["GC009"]
     assert rec["count"] == rec["pass3_count"] == 1
+
+
+def test_cli_rules_subset_can_select_pass4_only(tmp_path):
+    p = tmp_path / "conc.py"
+    p.write_text(FIXTURES["GC016"][0] + FIXTURES["GC006"][0])
+    proc = _run_cli("--json", "--rules", "GC016", str(p))
+    rec, problems = check_bench_stdout(proc.stdout, "graftcheck")
+    assert not problems, problems
+    assert [f["rule"] for f in rec["findings"]] == ["GC016"]
+    assert rec["count"] == rec["pass4_count"] == 1
+    assert rec["pass3_count"] == 0
+
+
+def test_cli_fail_on_new_reports_jit_surface_changes(tmp_path):
+    """A jit wrapper absent from the committed manifest fails
+    --fail-on-new even with zero findings: compile-surface growth is a
+    reviewed artifact, not a drive-by."""
+    p = tmp_path / "new_wrapper.py"
+    p.write_text(
+        "import jax\n\n@jax.jit\ndef brand_new_wrapper(x):\n    return x + 1\n"
+    )
+    proc = _run_cli("--json", "--fail-on-new", str(p))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rec, problems = check_bench_stdout(proc.stdout, "graftcheck")
+    assert not problems, problems
+    assert rec["count"] == rec["new_count"] == 0
+    assert rec["jit_surface_count"] == 1 and rec["jit_surface_new"] == 1
+    # without --fail-on-new the same file is informational only: exit 0
+    assert _run_cli(str(p)).returncode == 0
 
 
 def test_cli_fail_on_new_flags_findings_absent_from_baseline(tmp_path):

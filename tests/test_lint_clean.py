@@ -9,7 +9,13 @@ clock, or an uncited parity claim fails HERE with a rule ID and file:line
 import json
 import os
 
-from midgpt_tpu.analysis.__main__ import BASELINE_PATH, _default_paths
+from midgpt_tpu.analysis.__main__ import BASELINE_PATH, _default_paths, _repo_root
+from midgpt_tpu.analysis.concurrency import concurrency_paths
+from midgpt_tpu.analysis.jit_surface import (
+    JIT_SURFACE_BASELINE_PATH,
+    jit_surface,
+    load_baseline,
+)
 from midgpt_tpu.analysis.lifecycle import lifecycle_paths
 from midgpt_tpu.analysis.lint import iter_python_files, lint_paths, parse_suppressions
 
@@ -27,6 +33,32 @@ def test_tree_is_lifecycle_clean():
     active, _suppressed, n_files = lifecycle_paths(_default_paths())
     assert n_files > 50, "lifecycle roots resolved to almost nothing — path bug?"
     assert active == [], "\n" + "\n".join(f.format() for f in active)
+
+
+def test_tree_is_concurrency_clean():
+    """Pass 4 (GC013-GC016) on the whole tree: zero unsuppressed findings.
+    A thread-escape engine mutation, an allocating signal handler, a
+    non-plain-data handoff payload, or a field-dropping structured raise
+    fails here with file:line."""
+    active, _suppressed, n_files = concurrency_paths(_default_paths())
+    assert n_files > 50, "concurrency roots resolved to almost nothing — path bug?"
+    assert active == [], "\n" + "\n".join(f.format() for f in active)
+
+
+def test_jit_surface_baseline_pins_clean_tree():
+    """The committed jit-surface manifest must match the live census
+    exactly (and be non-empty — the tree HAS jit wrappers): a new wrapper,
+    a widened static-arg set, or a regressed GC011 verdict fails here
+    until the baseline is deliberately re-pinned via --update-baseline."""
+    current = jit_surface(_default_paths(), rel_to=_repo_root())
+    baseline = load_baseline(JIT_SURFACE_BASELINE_PATH)
+    assert len(baseline) > 0, "committed jit_surface_baseline.json is empty"
+    cur = {(e["path"], e["name"]): e for e in current}
+    base = {(e["path"], e["name"]): e for e in baseline}
+    assert cur == base, (
+        "jit surface drifted from the committed baseline; review the "
+        "change, then run `python -m midgpt_tpu.analysis --update-baseline`"
+    )
 
 
 def test_baseline_matches_clean_tree():
