@@ -543,7 +543,19 @@ def check_serve_fleet_bench(rec: dict) -> tp.List[str]:
         the single engine's; a lower rate means the rendezvous hash
         stopped steering templates to their pages.
       * pages_conserved — per-alive-replica pool law plus the spill
-        ledger closed after the drain."""
+        ledger closed after the drain.
+
+    With `procs` true (bench_serve.py --fleet --procs: replicas are
+    worker PROCESSES behind the socket transport, the fault a real kill
+    -9 — docs/ROBUSTNESS.md 'Cross-process fleet') two gates shift:
+    the hit-rate ordering is NOT required — a SIGKILLed worker takes
+    its per-process host-RAM tier with it, so the KV the in-process
+    crash path spills and re-adopts is unrecoverable and the survivor
+    honestly re-prefills (zero-drop and exact-parity still hold, and
+    still ARE required) — and the record must carry the transport
+    claim: proc_failovers >= 1 (the death was detected through the
+    wire) plus rpc_p50_ms / rpc_p95_ms / wire_bytes. Both branches are
+    drift-pinned by tests/test_bench_contract.py."""
     problems: tp.List[str] = []
     _require(
         rec,
@@ -606,11 +618,46 @@ def check_serve_fleet_bench(rec: dict) -> tp.List[str]:
     for name, v in (("fleet_hit_rate", fh), ("single_hit_rate", sh)):
         if isinstance(v, Number) and not 0.0 <= v <= 1.0:
             problems.append(f"{name} {v} outside [0, 1]")
-    if isinstance(fh, Number) and isinstance(sh, Number) and fh < sh:
+    procs = rec.get("procs", False)
+    if not isinstance(procs, bool):
+        problems.append(f"field 'procs' must be a bool, got {procs!r}")
+        procs = False
+    if (
+        not procs
+        and isinstance(fh, Number) and isinstance(sh, Number) and fh < sh
+    ):
         problems.append(
             f"fleet_hit_rate {fh} < single_hit_rate {sh} — affinity "
             "routing failed to protect the trie hit rate"
         )
+    if procs:
+        _require(
+            rec,
+            {
+                "proc_failovers": (int,),
+                "worker_pids": (list,),
+                "transport": (dict,),
+                "rpc_p50_ms": Number,
+                "rpc_p95_ms": Number,
+                "wire_bytes": (int,),
+            },
+            problems,
+        )
+        pf = rec.get("proc_failovers")
+        if isinstance(pf, int) and pf < 1:
+            problems.append(
+                f"proc_failovers {pf} < 1 — kill -9 never detected "
+                "through the wire, the cross-process A/B is vacuous"
+            )
+        wb = rec.get("wire_bytes")
+        if isinstance(wb, int) and wb < 1:
+            problems.append(
+                f"wire_bytes {wb} < 1 — no frame ever crossed the socket"
+            )
+        for key in ("rpc_p50_ms", "rpc_p95_ms"):
+            v = rec.get(key)
+            if isinstance(v, Number) and v < 0:
+                problems.append(f"{key} {v} < 0")
     if "pages_conserved" not in rec or rec["pages_conserved"] is not True:
         problems.append("field 'pages_conserved' must be literal true")
     return problems
@@ -710,6 +757,25 @@ def check_serve_slo_bench(rec: dict) -> tp.List[str]:
         if not isinstance(hr, Number) or not 0.0 <= hr <= 1.0:
             problems.append(
                 f"fleet record 'prefix_hit_rate' {hr!r} outside [0, 1]"
+            )
+    # optional cross-process block: present when loadgen ran --fleet
+    # --procs (replicas are worker processes behind the socket transport;
+    # docs/ROBUSTNESS.md "Cross-process fleet")
+    if rec.get("procs"):
+        if fs is None:
+            problems.append("procs is true but fleet_size is absent")
+        for key in ("rpc_p50_ms", "rpc_p95_ms"):
+            v = rec.get(key)
+            if not isinstance(v, Number) or v < 0:
+                problems.append(
+                    f"procs record field {key!r} must be a number >= 0, "
+                    f"got {v!r}"
+                )
+        wb = rec.get("wire_bytes")
+        if not isinstance(wb, int) or isinstance(wb, bool) or wb < 1:
+            problems.append(
+                f"procs record 'wire_bytes' {wb!r} must be an int >= 1 — "
+                "no frame ever crossed the socket"
             )
     return problems
 

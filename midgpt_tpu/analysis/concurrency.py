@@ -328,11 +328,16 @@ def _rule_gc014(mod: _Module) -> tp.Iterator[Finding]:
 # GC015 — wire contract for handoff/spill/failover payloads
 # ----------------------------------------------------------------------
 
-# Queue/tier classes whose contents cross (or will cross, ROADMAP item 4)
-# a process boundary, and the item classes that ride them.
-_WIRE_QUEUE_CTORS = frozenset({"PageHandoffQueue", "SpillTier"})
-_WIRE_ITEM_CTORS = frozenset({"HandoffItem", "FailoverItem", "_SpillEntry"})
-_WIRE_CHAIN_HINTS = ("handoff", "failover", "spill")
+# Queue/tier/transport classes whose contents cross a process boundary
+# (literally so since sampling/fleet_proc.py: ReplicaTransport frames them
+# onto a socket), and the item classes that ride them.
+_WIRE_QUEUE_CTORS = frozenset(
+    {"PageHandoffQueue", "SpillTier", "ReplicaTransport"}
+)
+_WIRE_ITEM_CTORS = frozenset(
+    {"HandoffItem", "FailoverItem", "_SpillEntry", "SpillTransferItem"}
+)
+_WIRE_CHAIN_HINTS = ("handoff", "failover", "spill", "transport")
 
 # The quantized-page wire shape: int8 pages + their dequant scales, nothing
 # else (sampling/disagg.py `_gather_pages` is the blessed producer).

@@ -73,4 +73,17 @@ ERROR_CONTRACTS: tp.Dict[str, ErrorContract] = {
     ),
     # sampling/disagg.py
     "HandoffRetryExhausted": ErrorContract(required=("uid", "attempts")),
+    # sampling/fleet_proc.py — the cross-process transport triad: a failed
+    # attempt (retryable), a rejected frame (pre-decode), a dead replica
+    # (retry budget spent). Handlers key on host/port/rpc to name the
+    # replica and verb in failover logs and chaos summaries.
+    "TransportError": ErrorContract(
+        required=("host", "port", "rpc"), optional=("deadline_s",)
+    ),
+    "WireFrameError": ErrorContract(
+        required=("reason",), optional=("nbytes",)
+    ),
+    "ReplicaGoneError": ErrorContract(
+        required=("host", "port", "rpc", "attempts")
+    ),
 }

@@ -52,6 +52,15 @@ stalled transport falls back to re-prefill and a corrupt page is caught
 by the take-side checksum, either way never a token mismatch — with
 cross-tier page conservation (assert_fleet_conserved) after the drain.
 
+The cross-process kinds (`proc_kill9` / `conn_drop` / `wire_corrupt` /
+`wire_stall`, `_run_proc_fleet_chaos`) run the same fleet gate with the
+replica boundary promoted to real worker PROCESSES behind the framed
+socket transport (sampling/fleet_proc.py): a hard `kill -9` of a worker
+mid-decode must be detected purely through the wire and produce the exact
+engine_crash failover story — zero drops, cross-process bit-parity,
+ledgers closing across the boundary — while the pure wire faults must be
+absorbed by the transport's checksum/deadline/retry machinery invisibly.
+
 Faults are deterministic for a seeded trace: round-keyed kinds fire on the
 engine's round counter (`kill_mid_decode@7` = round 7), slow_client keys on
 the victim uid, submit_storm keys on the arrival index at which the burst
@@ -67,6 +76,7 @@ AssertionError — when an invariant breaks without one.
 from __future__ import annotations
 
 import asyncio
+import subprocess
 import tempfile
 import typing as tp
 
@@ -89,14 +99,20 @@ STORM_BACKLOG_PAGES = 24
 RESIZE_TARGETS = [43, 37]
 
 
+def _tiny_cfg():
+    from midgpt_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(
+        block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32
+    )
+
+
 def _tiny_model(seed: int):
     import jax
 
-    from midgpt_tpu.models.gpt import GPT, GPTConfig
+    from midgpt_tpu.models.gpt import GPT
 
-    cfg = GPTConfig(
-        block_size=64, vocab_size=96, n_layer=2, n_head=2, n_embd=32
-    )
+    cfg = _tiny_cfg()
     return cfg, GPT.init(cfg, jax.random.PRNGKey(seed))
 
 
@@ -321,6 +337,13 @@ def run_serving_chaos(
     with `trace_dir` the Chrome trace + .prom metrics land there
     unconditionally; without one they land in a temp dir only when an
     invariant fails (the path rides the AssertionError)."""
+    if any(
+        k in fault_plan
+        for k in ("proc_kill9", "conn_drop", "wire_corrupt", "wire_stall")
+    ):
+        return _run_proc_fleet_chaos(
+            fault_plan, seed=seed, n_requests=n_requests, trace_dir=trace_dir
+        )
     if any(
         k in fault_plan
         for k in ("engine_crash", "handoff_stall", "spill_corrupt")
@@ -592,6 +615,241 @@ def _run_fleet_chaos(fault_plan, *, seed, n_requests, trace_dir):
         }
 
     return _run_scenario(obs, trace_dir, body)
+
+
+# -- cross-process fleet scenarios (sampling/fleet_proc.py) ----------------
+
+
+def proc_worker_spec(seed: int, *, cpu_devices: int = 1) -> tp.Dict[str, tp.Any]:
+    """Worker spec matching the chaos fleet geometry: the same tiny model
+    at the same seed (same-seed GPT.init on the same pinned CPU backend =>
+    bit-identical params in every process, the foundation of cross-process
+    greedy parity — pinned end to end by tests/test_fleet_proc.py) and the
+    31-page fleet pool. Workers have their OWN jit
+    caches, so 31 collides with nothing in the parent (the program-key
+    geometry ledger in _fleet_router's docstring is per-process)."""
+    import dataclasses as _dc
+
+    from midgpt_tpu.sampling.fleet_proc import parent_jax_config
+
+    return {
+        "model": _dc.asdict(_tiny_cfg()),
+        "seed": seed,
+        "engine": {
+            "max_slots": 3,
+            "page_size": 8,
+            "num_pages": 31,
+            "prefill_chunk": 16,
+            "decode_chunk": 4,
+            "cache_dtype": "float32",
+        },
+        "cpu_devices": cpu_devices,
+        "jax_config": parent_jax_config(),
+    }
+
+
+def _proc_reference_pass(port, trace):
+    """Fault-free single-engine pass driven over the wire on an
+    already-spawned worker (same spec, same pinned CPU backend as the
+    fleet workers) -> {trace index: full token array}. Running the
+    reference in-parent would compare across BACKENDS whenever the parent
+    sits on the real TPU (chaos_run.py without MIDGPT_PLATFORM) —
+    worker-vs-worker keeps the parity claim about the process boundary,
+    not about TPU-vs-CPU matmul bit patterns. Upfront submission (vs the
+    fleet drive's trickle) is fine: greedy streams are
+    batch-composition-independent, the same property every other parity
+    gate leans on (tests/test_fleet_proc.py runs this gate non-slow)."""
+    from midgpt_tpu.sampling.fleet_proc import connect_replica
+
+    faults.clear()
+    rep = connect_replica(port)
+    uid_to_idx = {}
+    for idx, (prompt, m) in enumerate(trace):
+        uid_to_idx[rep.submit(prompt, m)] = idx
+    r = 0
+    while not rep.idle:
+        rep.step()
+        r += 1
+        assert r < 10_000, "proc reference drive did not converge"
+    ref = {
+        idx: np.asarray(rep.finished[uid].tokens)
+        for uid, idx in uid_to_idx.items()
+    }
+    rep.close()
+    return ref
+
+
+def _run_proc_fleet_chaos(fault_plan, *, seed, n_requests, trace_dir):
+    """Cross-process fleet degradation gate (docs/ROBUSTNESS.md
+    "Cross-process fleet"): the _run_fleet_chaos invariants with the
+    replica boundary promoted to a real OS process boundary — two worker
+    PROCESSES (fleet_proc.spawn_worker, each its own jax backend and jit
+    cache) behind a FleetRouter speaking the framed socket transport.
+
+      1. Alive: `proc_kill9` SIGKILLs the busiest worker mid-decode and
+         the fleet still finishes every accepted stream — detection flows
+         purely through the wire (ReplicaGoneError -> consecutive-failure
+         health check -> the same _crash failover as engine_crash), zero
+         drops, bounded requeue then structured shed.
+      2. Conserved, across the process boundary: alive workers run the
+         pool law + spill ledger IN-process over the `conserve` RPC
+         (assert_fleet_conserved dispatches), and the router-side tier
+         ledger closes.
+      3. Bit-identical: every stream — survivors and failover replays —
+         matches a fault-free single-engine reference served by its own
+         worker process (_proc_reference_pass), proving params, prefill,
+         and decode agree bit-for-bit across process boundaries.
+
+    The wire kinds (`conn_drop` / `wire_corrupt` / `wire_stall`) must be
+    absorbed by the transport invisibly: same zero-drop, same parity, plus
+    the per-kind transport counter proving the fault actually bit
+    (reconnects / corrupt_frames / deadline_expiries).
+
+    The router process must also compile NOTHING: the parent's jit census
+    (ServeEngine.compile_stats) is snapshotted up front and pinned
+    unchanged after the drive — the whole scenario runs without a single
+    parent-process engine program. Pinned by tests/test_fleet_proc.py
+    (kill-and-survive representative + slow wire-kind scenarios)."""
+    from midgpt_tpu.sampling.fleet import FleetRouter, assert_fleet_conserved
+    from midgpt_tpu.sampling.fleet_proc import connect_replica, spawn_workers
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    cfg = _tiny_cfg()
+    trace = _trace(cfg, seed + 1, n_requests, shared=True)
+    compiles_before = ServeEngine.compile_stats()
+    spec = proc_worker_spec(seed)
+    procs = []
+    try:
+        # all three workers (reference + 2 replicas) spawn CONCURRENTLY:
+        # jax import + engine build overlap, and the fleet workers keep
+        # warming while the reference pass drives worker 0
+        procs = spawn_workers(spec, 3)
+        ref_tokens = _proc_reference_pass(procs[0][1], trace)
+        procs[0][0].kill()
+
+        faults.clear()
+        armed = faults.activate_plan(fault_plan)
+        obs = Observability()
+        replicas = [
+            connect_replica(port, retry_base_s=0.05, obs=obs)
+            for _, port in procs[1:]
+        ]
+        router = FleetRouter(replicas)
+
+        def body() -> tp.Dict[str, tp.Any]:
+            uid_to_idx: tp.Dict[int, int] = {}
+            pending = list(enumerate(trace))
+            r = 0
+            while pending or not router.idle:
+                if pending:
+                    idx, (prompt, m) = pending.pop(0)
+                    # trickled one per round (like _run_fleet_chaos): the
+                    # round-keyed kill deterministically lands mid-decode
+                    uid_to_idx[router.submit_retry(prompt, m)] = idx
+                router.step()
+                r += 1
+                # wider guard than the in-process drive: kill -9 detection
+                # costs max_consecutive_failures failed rounds first
+                assert r < 20_000, "proc fleet drive did not converge"
+            fired = faults.fired_counts()
+            faults.clear()
+
+            # -- invariant 2, across the process boundary ---------------
+            assert_fleet_conserved(router, "after proc drain")
+
+            # -- invariants 1 + 3: zero drops, bit-parity cross-process -
+            statuses: tp.Dict[str, int] = {}
+            parity_checked = parity_ok = 0
+            for uid, idx in uid_to_idx.items():
+                fr = router.finished.get(uid)
+                assert fr is not None, f"accepted stream {uid} vanished"
+                statuses[fr.status] = statuses.get(fr.status, 0) + 1
+                assert fr.status == "ok", (
+                    f"accepted stream {uid} dropped with status "
+                    f"{fr.status!r}"
+                )
+                parity_checked += 1
+                if np.array_equal(np.asarray(fr.tokens), ref_tokens[idx]):
+                    parity_ok += 1
+            assert parity_ok == parity_checked, (
+                f"greedy parity broke on {parity_checked - parity_ok} "
+                "stream(s) vs the fault-free in-process reference"
+            )
+            assert sum(fired.values()) >= min(1, len(armed)), (
+                "no armed fault fired"
+            )
+            transport = router.transport_stats()
+            if fired.get("proc_kill9"):
+                assert router.proc_failovers >= 1, (
+                    "kill -9 fired but the wire never reported the death"
+                )
+                assert router.failed_over_streams >= 1, (
+                    "kill -9 fired with no accepted streams to fail over "
+                    "— the gate proved nothing"
+                )
+            if fired.get("conn_drop"):
+                assert transport["reconnects"] >= 1, (
+                    "connection dropped but no RPC ever reconnected"
+                )
+            if fired.get("wire_corrupt"):
+                assert transport["corrupt_frames"] >= 1, (
+                    "frame corruption armed but the checksum never "
+                    "rejected one"
+                )
+            if fired.get("wire_stall"):
+                assert transport["deadline_expiries"] >= 1, (
+                    "stall armed but no RPC deadline ever expired"
+                )
+
+            # -- recompile pin: the router process compiled nothing -----
+            compiles_after = ServeEngine.compile_stats()
+            assert compiles_after == compiles_before, (
+                f"router process compiled programs for proc replicas: "
+                f"{compiles_before} -> {compiles_after}"
+            )
+
+            return {
+                "mode": "serve",
+                "fault_plan": fault_plan,
+                "faults_fired": fired,
+                "n_requests": n_requests,
+                "statuses": statuses,
+                "shed": router.router_shed,
+                "timeouts": sum(e.timeouts for e in router.engines),
+                "cancelled": sum(e.cancelled for e in router.engines),
+                "decode_kills": sum(e.decode_kills for e in router.engines),
+                "preemptions": sum(e.preemptions for e in router.engines),
+                "poisoned": 0,
+                "parity_checked": parity_checked,
+                "parity_ok": parity_ok,
+                "pages_conserved": True,
+                "prefix_cache": True,
+                "prefix_reclaimed": sum(
+                    e.prefix_evictions for e in router.engines
+                ),
+                "prefix_hit_rate": router.prefix_hit_rate(),
+                "fleet_size": len(router.engines),
+                "alive": sum(router.alive),
+                "failovers": router.failovers,
+                "failed_over_streams": router.failed_over_streams,
+                "dropped_streams": 0,
+                "spill": router.spill.stats(),
+                "procs": True,
+                "proc_failovers": router.proc_failovers,
+                "worker_pids": [rep.pid for rep in replicas],
+                "transport": transport,
+                "router_compiles_delta": 0,
+            }
+
+        return _run_scenario(obs, trace_dir, body)
+    finally:
+        faults.clear()
+        for proc, _port in procs:
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
 
 
 # -- model-ops scenarios (sampling/ops.py) ---------------------------------

@@ -116,6 +116,36 @@ ROUTER round counter; scenarios in robustness/chaos_serve.py):
                      verification must discard it and re-prefill — a
                      corrupt spill page never yields a token mismatch.
 
+Cross-process fleet kinds (hooked in sampling/fleet.py
+`FleetRouter._fire_proc_faults`, keyed on the ROUTER round counter;
+targets the busiest alive ProcReplica — sampling/fleet_proc.py; scenario
+in robustness/chaos_serve.py `_run_proc_fleet_chaos`):
+
+  proc_kill9         SIGKILL the busiest worker PROCESS mid-decode — no
+                     drain, no flush, no goodbye. The router must detect
+                     the death purely through the wire (step RPCs fail
+                     with ReplicaGoneError until the consecutive-failure
+                     health check fires), then run the exact engine_crash
+                     failover: zero dropped accepted streams, greedy
+                     bit-parity on the survivor, router + spill ledgers
+                     closing across the process boundary.
+  conn_drop          abruptly close the live router->worker connection;
+                     the transport must reconnect transparently on the
+                     next RPC (counted `reconnects`) with zero stream
+                     impact — the worker keeps its state, only the socket
+                     died.
+  wire_corrupt       flip a byte in the next received frame BEFORE
+                     verification: the crc32 check must reject it
+                     pre-decode (WireFrameError, counted
+                     `corrupt_frames`), drop the desynced connection, and
+                     recover by retrying the RPC on a fresh one — corrupt
+                     bytes never reach a decode, mirroring spill_corrupt.
+  wire_stall         the next RPC's response never lands inside its
+                     deadline (wedged worker / dead tunnel): the deadline
+                     must expire into a structured TransportError
+                     (counted `deadline_expiries`) and the bounded
+                     backoff retry must absorb it.
+
 Activation: programmatic (`activate(...)`), or a plan string from config
 (`ExperimentConfig.fault_plan`) / the MIDGPT_FAULTS env var, parsed by
 `activate_plan`: comma-separated `kind[@step][*times]`, e.g.
@@ -152,6 +182,12 @@ KINDS = (
     "engine_crash",
     "handoff_stall",
     "spill_corrupt",
+    # cross-process fleet (sampling/fleet.py _fire_proc_faults against
+    # fleet_proc.py ProcReplica workers, chaos_serve.py)
+    "proc_kill9",
+    "conn_drop",
+    "wire_corrupt",
+    "wire_stall",
 )
 
 # One-line summaries for operator tooling (`tools/chaos_run.py --serve
@@ -177,9 +213,16 @@ DESCRIPTIONS: tp.Dict[str, str] = {
     "engine_crash": "kill the busiest fleet replica; streams fail over to survivors",
     "handoff_stall": "wedge the spill-tier transport; admissions re-prefill instead",
     "spill_corrupt": "bit-flip a spilled host-RAM KV page; checksum must catch it",
+    "proc_kill9": "SIGKILL the busiest worker process; wire-detected failover",
+    "conn_drop": "drop the live router->worker socket; next RPC reconnects",
+    "wire_corrupt": "bit-flip the next wire frame; crc32 rejects pre-decode",
+    "wire_stall": "next RPC response misses its deadline; backoff absorbs it",
 }
 
-_PLAN_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$")
+# kind names may carry digits (proc_kill9); `@` still separates the step
+_PLAN_RE = re.compile(
+    r"^(?P<kind>[a-z_][a-z0-9_]*?)(?:@(?P<step>\d+))?(?:\*(?P<times>\d+))?$"
+)
 
 
 @dataclasses.dataclass

@@ -60,6 +60,21 @@ spill tier's ledger closes — resident + readopted + corrupt_discarded +
 capacity_dropped + stale_discarded == total_spilled. The fleet chaos
 scenarios (robustness/chaos_serve.py: engine_crash / handoff_stall /
 spill_corrupt) assert both after every drain.
+
+Cross-process fleets (sampling/fleet_proc.py, docs/ROBUSTNESS.md
+"Cross-process fleet"): a replica may be a `ProcReplica` — a proxy for a
+worker PROCESS hosting the engine behind the framed socket transport.
+The router drives it through the same duck-typed surface, so everything
+above holds unchanged; what this module adds for that mode is (a) the
+wire-level fault kinds (`proc_kill9` / `conn_drop` / `wire_corrupt` /
+`wire_stall`) fired from `step()` against proc replicas — kill -9
+detection deliberately flows through the SAME consecutive-failure health
+path as an in-process engine death, fed by `ReplicaGoneError` off the
+wire; (b) spill-page transfer (`SpillTier.export_entries` /
+`import_entries`) whose `transferred`/`received` buckets keep the ledger
+law closing when pages cross a process boundary; and (c) per-replica
+dispatch in `assert_fleet_conserved`, which runs the pool law INSIDE the
+worker (over the `conserve` RPC) for proc replicas.
 """
 
 from __future__ import annotations
@@ -142,6 +157,10 @@ class SpillTier:
         self.corrupt_discarded = 0
         self.capacity_dropped = 0
         self.stale_discarded = 0
+        # cross-process transfer buckets (fleet_proc.py): pages that
+        # entered/left this tier over the wire rather than via spill/take
+        self.received = 0
+        self.transferred = 0
         # non-ledger visibility counters
         self.duplicate_skips = 0
         self.stall_fallbacks = 0
@@ -296,6 +315,63 @@ class SpillTier:
         e.blocks["k"] = k
         return True
 
+    # -- cross-process transfer (fleet_proc.py) ------------------------
+
+    def export_entries(self):
+        """Move every resident entry out of this tier for wire transfer
+        (typically a draining worker handing its spilled KV to survivors).
+        Move-on-export like take_run: the pages leave this ledger through
+        the `transferred` bucket and re-enter the receiver's through
+        `received` — both sides' conservation laws keep closing. Checksums
+        travel UNVERIFIED and UNCHANGED: the receiver's take-side check
+        then covers transit and residence with one number."""
+        from midgpt_tpu.sampling.fleet_proc import SpillTransferItem
+
+        items = [
+            SpillTransferItem(
+                key=key,
+                blocks=e.blocks,
+                checksum=e.checksum,
+                weights_version=e.weights_version,
+            )
+            for key, e in sorted(
+                self._entries.items(), key=lambda kv: kv[1].stamp
+            )
+        ]
+        self._entries.clear()
+        self.transferred += len(items)
+        return items
+
+    def import_entries(self, items) -> int:
+        """Land wire-transferred entries in this tier, preserving each
+        page's ORIGINAL spill-time checksum (a bit flipped in transit is
+        caught by the normal take_run verification — corrupt KV degrades
+        to re-prefill, never poisons a decode). A resident duplicate under
+        the same weights_version wins (`duplicate_skips`); a stale one is
+        replaced (`stale_discarded`). Returns the number imported."""
+        imported = 0
+        for it in items:
+            key = tuple(int(t) for t in it.key)
+            self.received += 1
+            imported += 1
+            existing = self._entries.get(key)
+            if existing is not None:
+                if existing.weights_version == it.weights_version:
+                    # resident copy is equivalent: the incoming page goes
+                    # straight to the discard bucket it would reach anyway
+                    self.duplicate_skips += 1
+                    self.stale_discarded += 1
+                    continue
+                del self._entries[key]
+                self.stale_discarded += 1
+            self._tick += 1
+            self._entries[key] = _SpillEntry(
+                dict(it.blocks), int(it.checksum), it.weights_version,
+                self._tick,
+            )
+        self._enforce_capacity()
+        return imported
+
     # -- accounting ----------------------------------------------------
 
     def resident_count(self) -> int:
@@ -312,9 +388,15 @@ class SpillTier:
             "corrupt_discarded": self.corrupt_discarded,
             "capacity_dropped": self.capacity_dropped,
             "stale_discarded": self.stale_discarded,
+            "received": self.received,
+            "transferred": self.transferred,
         }
 
     def assert_ledger(self, where: str = "") -> None:
+        """Pages in == pages accounted for. Sources: spilled locally or
+        received over the wire. Sinks: resident, readopted, one of the
+        discard buckets, or transferred away. Identical to the pre-proc
+        law when both transfer buckets are zero."""
         led = self.ledger()
         total = (
             led["resident"]
@@ -322,8 +404,9 @@ class SpillTier:
             + led["corrupt_discarded"]
             + led["capacity_dropped"]
             + led["stale_discarded"]
+            + led["transferred"]
         )
-        assert total == led["total_spilled"], (
+        assert total == led["total_spilled"] + led["received"], (
             f"spill ledger violated {where}: {led} "
             f"(buckets sum to {total})"
         )
@@ -441,6 +524,15 @@ class FleetRouter:
         self.router_shed = 0  # submit-time total refusals (all replicas)
         self.shed_streams = 0  # failovers terminally shed past the budget
         self.crash_log: tp.List[tp.Dict[str, tp.Any]] = []
+        # cross-process replicas (fleet_proc.ProcReplica marks itself):
+        # the wire-level fault kinds in step() only target these, and
+        # their deaths are counted separately for the serve_fleet profile
+        self._proc_idx = [
+            i
+            for i, eng in enumerate(engines)
+            if getattr(eng, "is_proc", False)
+        ]
+        self.proc_failovers = 0
 
     # -- admission -----------------------------------------------------
 
@@ -583,6 +675,7 @@ class FleetRouter:
             "spill_corrupt", step=self.rounds
         ):
             self.spill.corrupt_one()
+        self._fire_proc_faults()
         for i, eng in enumerate(self.engines):
             if not self.alive[i]:
                 continue
@@ -608,15 +701,42 @@ class FleetRouter:
         self._harvest()
         self._drain_failover()
 
-    def _crash_victim(self) -> int:
-        """The engine_crash fault's target: the alive replica holding the
-        most accepted streams (maximal failover work; deterministic
-        low-index tie-break)."""
-        load = {i: 0 for i, a in enumerate(self.alive) if a}
+    def _fire_proc_faults(self) -> None:
+        """The wire-level fault kinds (robustness/faults.py "cross-process
+        fleet" section), targeting the busiest alive proc replica so the
+        fault lands under real traffic. `proc_kill9` SIGKILLs the worker
+        and deliberately does NOT mark it dead here: detection must flow
+        through the same health checks as any other replica death — step
+        RPCs fail with ReplicaGoneError until the consecutive-failure
+        threshold fires `_crash`. The other three arm transport-level
+        chaos the RPC retry path must absorb transparently."""
+        procs = [i for i in self._proc_idx if self.alive[i]]
+        if not procs:
+            return
+        victim = self._busiest(procs)
+        if sum(self.alive) > 1 and faults.should_fire(
+            "proc_kill9", step=self.rounds
+        ):
+            self.engines[victim].kill9()
+        if faults.should_fire("conn_drop", step=self.rounds):
+            self.engines[victim].drop_conn()
+        if faults.should_fire("wire_corrupt", step=self.rounds):
+            self.engines[victim].arm_wire_corrupt()
+        if faults.should_fire("wire_stall", step=self.rounds):
+            self.engines[victim].arm_wire_stall()
+
+    def _busiest(self, candidates: tp.List[int]) -> int:
+        load = {i: 0 for i in candidates}
         for st in self._pending.values():
             if st.replica in load:
                 load[st.replica] += 1
         return max(sorted(load), key=lambda i: load[i])
+
+    def _crash_victim(self) -> int:
+        """The engine_crash fault's target: the alive replica holding the
+        most accepted streams (maximal failover work; deterministic
+        low-index tie-break)."""
+        return self._busiest([i for i, a in enumerate(self.alive) if a])
 
     def _crash(self, i: int, *, reason: str) -> None:
         """Mark replica `i` dead and fail its streams over: harvest what
@@ -629,9 +749,17 @@ class FleetRouter:
             return
         self.alive[i] = False
         self.failovers += 1
+        if getattr(self.engines[i], "is_proc", False):
+            self.proc_failovers += 1
         self.crash_log.append(
             {"replica": i, "round": self.rounds, "reason": reason}
         )
+        # proc replicas: tear the transport down and make sure the worker
+        # process is gone — a half-alive worker must not keep serving a
+        # router that already failed its streams over
+        closer = getattr(self.engines[i], "on_router_crash", None)
+        if closer is not None:
+            closer()
         self._harvest_engine(i)
         moved = sorted(
             (st for st in self._pending.values() if st.replica == i),
@@ -723,18 +851,41 @@ class FleetRouter:
         matchable = sum(e._prefix_matchable_tokens for e in self.engines)
         return matched / matchable if matchable else 0.0
 
+    def transport_stats(self) -> tp.Optional[tp.Dict[str, tp.Any]]:
+        """Wire-level rollup over the proc replicas (None for a pure
+        in-process fleet): summed volume/recovery counters, mean p50 and
+        worst p95 latency — the serve_fleet profile's transport fields."""
+        if not self._proc_idx:
+            return None
+        per = [self.engines[i].transport.stats() for i in self._proc_idx]
+        out: tp.Dict[str, tp.Any] = {
+            k: sum(s[k] for s in per)
+            for k in (
+                "rpc_count", "wire_bytes", "connects", "reconnects",
+                "retries", "corrupt_frames", "deadline_expiries",
+                "forced_drops",
+            )
+        }
+        out["rpc_p50_ms"] = round(
+            sum(s["rpc_p50_ms"] for s in per) / len(per), 3
+        )
+        out["rpc_p95_ms"] = max(s["rpc_p95_ms"] for s in per)
+        return out
+
     def stats(self) -> tp.Dict[str, tp.Any]:
         return {
             "fleet_size": len(self.engines),
             "alive": sum(self.alive),
             "rounds": self.rounds,
             "failovers": self.failovers,
+            "proc_failovers": self.proc_failovers,
             "failed_over_streams": self.failed_over_streams,
             "router_shed": self.router_shed,
             "shed_streams": self.shed_streams,
             "prefix_hit_rate": self.prefix_hit_rate(),
             "failover_queue": self.failover_queue.stats(),
             "spill": self.spill.stats(),
+            "transport": self.transport_stats(),
             "crash_log": list(self.crash_log),
             "replicas": [
                 {
@@ -757,10 +908,19 @@ def assert_fleet_conserved(router: FleetRouter, where: str = "") -> None:
     with it), and the shared spill tier's ledger closes (every page ever
     spilled is resident, readopted, or accounted discarded). Chaos
     scenarios assert this after every drain, including the spill-corrupt
-    discard paths."""
+    discard paths.
+
+    Cross-process replicas run the pool law INSIDE the worker (the pages
+    live there) over the `conserve` RPC — the law closes ACROSS the
+    process boundary, with the worker-side verdict surfacing as the same
+    AssertionError the in-process path raises."""
     from midgpt_tpu.sampling import ops
 
     for i, eng in enumerate(router.engines):
-        if router.alive[i]:
+        if not router.alive[i]:
+            continue
+        if getattr(eng, "is_proc", False):
+            eng.assert_conserved(f"{where} fleet replica {i}")
+        else:
             ops.assert_conserved(eng, f"{where} fleet replica {i}")
     router.spill.assert_ledger(where)

@@ -362,6 +362,43 @@ def test_bench_serve_fleet_emits_conformant_json_line(capsys):
 
 
 @pytest.mark.slow
+def test_bench_serve_proc_fleet_emits_conformant_json_line(capsys):
+    """--fleet --procs: the serve_fleet line from a cross-process fleet
+    (worker processes behind the socket transport, a real kill -9
+    mid-trace — docs/ROBUSTNESS.md 'Cross-process fleet') must conform,
+    carry the transport claim, and hold zero-drop + exact parity across
+    the process boundary. Tiny shapes — structure check."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--fleet", "2",
+            "--procs",
+            "--n-requests", "10",
+            "--block-size", "64",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "2",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_fleet")
+    assert not problems, problems
+    assert rec["procs"] is True
+    assert rec["fleet_size"] == 2 and rec["alive"] == 1
+    assert rec["proc_failovers"] >= 1 and rec["failovers"] >= 1
+    assert rec["dropped"] == 0
+    assert rec["greedy_match_frac"] == 1.0
+    assert rec["parity_checked"] == 10
+    assert rec["wire_bytes"] >= 1
+    assert rec["transport"]["rpc_count"] >= 1
+    assert rec["router_compiles_delta"] == 0
+
+
+@pytest.mark.slow
 def test_loadgen_hot_swap_surfaces_version_transition(capsys):
     """tools/loadgen.py --hot-swap: the serve_slo line still conforms, a
     swap lands at every point, the headline carries the version
@@ -645,6 +682,32 @@ def test_serve_fleet_checker_catches_drift():
         "pages_conserved" in p
         for p in check_serve_fleet_bench(dict(good, pages_conserved="yes"))
     )
+    # cross-process variant (bench_serve --fleet --procs): the hit-rate
+    # ordering is waived — a SIGKILLed worker takes its host-RAM tier
+    # with it, so the survivor honestly re-prefills — but the transport
+    # claim becomes required (docs/ROBUSTNESS.md "Cross-process fleet")
+    procs = dict(
+        good, procs=True, fleet_hit_rate=0.1,
+        proc_failovers=1, worker_pids=[11, 12], transport={},
+        rpc_p50_ms=0.5, rpc_p95_ms=20.0, wire_bytes=4096,
+    )
+    assert check_serve_fleet_bench(procs) == []
+    assert any(
+        "proc_failovers" in p
+        for p in check_serve_fleet_bench(dict(procs, proc_failovers=0))
+    )
+    assert any(
+        "wire_bytes" in p
+        for p in check_serve_fleet_bench(dict(procs, wire_bytes=0))
+    )
+    no_rpc = dict(procs)
+    no_rpc.pop("rpc_p50_ms")
+    assert any("rpc_p50_ms" in p for p in check_serve_fleet_bench(no_rpc))
+    # the waiver is procs-only: the same diluted trie still fails in-proc
+    assert any(
+        "hit_rate" in p
+        for p in check_serve_fleet_bench(dict(procs, procs=False))
+    )
 
 
 def test_serve_slo_checker_catches_drift():
@@ -694,6 +757,20 @@ def test_serve_slo_checker_catches_drift():
     # shed_frac outside [0, 1] is a contract violation, not a number
     assert any("outside" in p
                for p in check_serve_slo_bench(dict(good, shed_frac=1.5)))
+    # cross-process fleet (loadgen --fleet --procs): the transport
+    # headline must be present and sane when procs is true
+    procs = dict(
+        good, procs=True, fleet_size=2, failovers=0, spill_hits=0,
+        prefix_hit_rate=0.0, rpc_p50_ms=0.5, rpc_p95_ms=9.0,
+        wire_bytes=1024,
+    )
+    assert check_serve_slo_bench(procs) == []
+    assert any("wire_bytes" in p
+               for p in check_serve_slo_bench(dict(procs, wire_bytes=0)))
+    assert any("rpc_p95_ms" in p
+               for p in check_serve_slo_bench(dict(procs, rpc_p95_ms=-1.0)))
+    assert any("fleet_size" in p
+               for p in check_serve_slo_bench(dict(procs, fleet_size=None)))
 
 
 def test_train_chaos_checker_catches_drift():

@@ -743,6 +743,11 @@ def test_gc016_registry_matches_live_class_signatures():
         StepHangError,
     )
     from midgpt_tpu.sampling.disagg import HandoffRetryExhausted
+    from midgpt_tpu.sampling.fleet_proc import (
+        ReplicaGoneError,
+        TransportError,
+        WireFrameError,
+    )
     from midgpt_tpu.sampling.ops import HotSwapError, PoolResizeError
     from midgpt_tpu.sampling.serve import BackpressureError
 
@@ -755,6 +760,9 @@ def test_gc016_registry_matches_live_class_signatures():
         "PoolResizeError": PoolResizeError,
         "BackpressureError": BackpressureError,
         "HandoffRetryExhausted": HandoffRetryExhausted,
+        "TransportError": TransportError,
+        "WireFrameError": WireFrameError,
+        "ReplicaGoneError": ReplicaGoneError,
     }
     assert set(classes) == set(ERROR_CONTRACTS)
     for name, cls in classes.items():

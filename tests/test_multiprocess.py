@@ -30,6 +30,31 @@ requires_multiproc_cpu = pytest.mark.skipif(
 )
 
 
+def test_skip_pin_is_version_agnostic():
+    """The env-gap skip must track the real jax version — on this image's
+    jax (<0.5) the SPMD tests skip; the moment the image moves to >=0.5
+    they run again with no edit here. Cross-process SERVING never hides
+    behind this pin: sampling/fleet_proc.py deliberately uses no
+    jax.distributed (plain sockets, zero collectives — replicas share no
+    arrays), so tests/test_fleet_proc.py runs its process-boundary gates
+    on this same jax."""
+    assert requires_multiproc_cpu.args[0] == (_JAX < (0, 5))
+    # and the serving transport really carries no distributed dependency
+    # (AST, not text: the module docstring SAYS "no jax.distributed")
+    import ast
+    import inspect
+
+    import midgpt_tpu.sampling.fleet_proc as fleet_proc
+
+    tree = ast.parse(inspect.getsource(fleet_proc))
+    refs = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Attribute) and node.attr == "distributed"
+    ]
+    assert not refs, "fleet_proc.py grew a jax.distributed dependency"
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -495,6 +495,8 @@ def _fleet_bench(args, cfg, params, cache_dtype) -> int:
 
     if args.fleet < 2:
         raise SystemExit("--fleet needs >= 2 replicas (one cannot fail over)")
+    if args.procs:
+        return _proc_fleet_bench(args, cfg)
 
     rng = np.random.default_rng(args.seed)
     V = cfg.vocab_size
@@ -627,11 +629,233 @@ def _fleet_bench(args, cfg, params, cache_dtype) -> int:
                 ),
                 "spill": router.spill.stats(),
                 "pages_conserved": True,
+                "procs": False,
                 "compile_counts": ServeEngine.compile_stats(),
             }
         )
     )
     return 0
+
+
+def _proc_fleet_bench(args, cfg) -> int:
+    """--fleet --procs: the fleet availability A/B with every replica a
+    separate worker PROCESS (sampling/fleet_proc.py) behind the framed
+    socket transport, and the mid-trace fault a real kill -9
+    (docs/ROBUSTNESS.md 'Cross-process fleet'). Two differences from the
+    in-process A/B, both forced by real process death:
+
+      * the single-engine reference runs in its OWN worker process (same
+        spec, same pinned CPU backend as the fleet workers) — an
+        in-parent reference would compare across backends whenever the
+        parent sits on the real TPU, and the parent must compile NOTHING
+        (its jit census is snapshotted up front and pinned unchanged);
+      * there is no fleet_hit_rate >= single_hit_rate gate: a SIGKILLed
+        worker takes its per-process host-RAM tier with it, so the KV
+        the in-process crash path spills and re-adopts is simply gone —
+        the survivor re-prefills the failed-over streams (bit-exactly;
+        the parity gate still covers every stream), which is honest
+        misses. bench_contract.check_serve_fleet_bench branches on the
+        `procs` field for exactly this reason.
+
+    Both sides are timed with warm worker jit caches (one untimed pass
+    each, like the in-process warm run) and hit rates are deltas over
+    the timed window only. The line carries the transport A/B fields —
+    rpc_p50_ms / rpc_p95_ms / wire_bytes / proc_failovers — pinned by
+    tests/test_bench_contract.py."""
+    import dataclasses as _dc
+    import subprocess
+
+    import numpy as np
+
+    from midgpt_tpu.robustness import faults
+    from midgpt_tpu.sampling.fleet import FleetRouter, assert_fleet_conserved
+    from midgpt_tpu.sampling.fleet_proc import (
+        connect_replica,
+        parent_jax_config,
+        spawn_workers,
+    )
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    rng = np.random.default_rng(args.seed)
+    V = cfg.vocab_size
+    n_templates = args.prefix_templates
+    t_len = args.template_tokens or 5 * args.page_size
+    templates = [
+        rng.integers(0, V, t_len, dtype=np.int64) for _ in range(n_templates)
+    ]
+    trace = []
+    for i in range(args.n_requests):
+        tail = rng.integers(0, V, int(rng.integers(3, 9)), dtype=np.int64)
+        prompt = np.concatenate([templates[i % n_templates], tail])
+        trace.append((prompt, int(rng.integers(8, 13))))
+    total_new = sum(m for _, m in trace)
+    half = len(trace) // 2
+    num_pages = 41  # the in-process fleet-bench geometry; workers own
+    # their jit caches, so the program-key ledger concern is per-process
+
+    compiles_before = ServeEngine.compile_stats()
+    spec = {
+        "model": _dc.asdict(cfg),
+        "seed": args.seed,
+        "engine": {
+            "max_slots": args.max_slots,
+            "page_size": args.page_size,
+            "num_pages": num_pages,
+            "prefill_chunk": args.prefill_chunk,
+            "decode_chunk": args.decode_chunk,
+            "cache_dtype": "int8" if args.kv_dtype == "int8" else "bfloat16",
+        },
+        "cpu_devices": args.cpu_devices or 1,
+        "jax_config": parent_jax_config(),
+    }
+
+    def prefix_counts(reps):
+        return (
+            sum(r._prefix_matched_tokens for r in reps),
+            sum(r._prefix_matchable_tokens for r in reps),
+        )
+
+    def ref_pass(rep):
+        # the run_single procedure over the wire: half, flush, half
+        t0 = time.perf_counter()
+        uids = [rep.submit(p, m) for p, m in trace[:half]]
+        rep.run()
+        rep._evict_shared_prefix_fault()
+        uids += [rep.submit(p, m) for p, m in trace[half:]]
+        rep.run()
+        return uids, time.perf_counter() - t0
+
+    procs = []
+    try:
+        # reference worker + N fleet workers, spawned concurrently
+        procs = spawn_workers(spec, args.fleet + 1)
+        ref = connect_replica(procs[0][1], retry_base_s=0.05)
+        ref_pass(ref)  # warm the reference worker's jit cache
+        m0, a0 = prefix_counts([ref])
+        ref_uids, dt_single = ref_pass(ref)
+        m1, a1 = prefix_counts([ref])
+        single_hit = (m1 - m0) / max(a1 - a0, 1)
+        single_tokens = {
+            idx: np.asarray(ref.finished[uid].tokens)
+            for idx, uid in enumerate(ref_uids)
+        }
+        ref.close()
+        procs[0][0].kill()
+
+        replicas = [
+            connect_replica(port, retry_base_s=0.05) for _, port in procs[1:]
+        ]
+        for rep in replicas:
+            ref_pass(rep)  # warm each fleet worker's jit cache
+        wm, wa = prefix_counts(replicas)
+        faults.clear()
+        faults.activate("proc_kill9", step=args.fleet_crash_round)
+        router = FleetRouter(replicas)
+
+        uid_to_idx: dict = {}
+
+        def drive(pending, r):
+            # trickled one per round so the kill finds streams in flight
+            while pending or not router.idle:
+                if pending:
+                    idx, (p, m) = pending.pop(0)
+                    uid_to_idx[router.submit_retry(p, m)] = idx
+                router.step()
+                r += 1
+                if r >= 100_000:
+                    raise SystemExit("proc fleet drive did not converge")
+            return r
+
+        t0 = time.perf_counter()
+        r = drive(list(enumerate(trace[:half])), 0)
+        for i, rep in enumerate(router.engines):
+            if router.alive[i]:
+                rep._evict_shared_prefix_fault()  # same flush, over the wire
+        drive(list(enumerate(trace[half:], start=half)), r)
+        dt_fleet = time.perf_counter() - t0
+        faults.clear()
+        assert_fleet_conserved(router, "proc fleet bench")
+        fm, fa = prefix_counts(replicas)
+        fleet_hit = (fm - wm) / max(fa - wa, 1)
+
+        match = total = dropped = parity_checked = 0
+        for uid, idx in uid_to_idx.items():
+            fr = router.finished.get(uid)
+            if fr is None or fr.status != "ok":
+                dropped += 1
+                continue
+            parity_checked += 1
+            a = np.asarray(fr.tokens)
+            b = single_tokens[idx]
+            n = min(len(a), len(b))
+            match += int(np.sum(a[:n] == b[:n]))
+            total += max(len(a), len(b))
+
+        transport = router.transport_stats()
+        compiles_after = ServeEngine.compile_stats()
+        assert compiles_after == compiles_before, (
+            f"router process compiled programs for proc replicas: "
+            f"{compiles_before} -> {compiles_after}"
+        )
+
+        print(
+            json.dumps(
+                {
+                    "bench": "serve_fleet",
+                    # the workers' backend — the parent dispatches nothing
+                    "backend": "cpu",
+                    "n_requests": args.n_requests,
+                    "total_new_tokens": total_new,
+                    "fleet_size": args.fleet,
+                    "max_slots": args.max_slots,
+                    "page_size": args.page_size,
+                    "kv_dtype": args.kv_dtype,
+                    "num_pages": num_pages,
+                    "n_templates": n_templates,
+                    "template_tokens": t_len,
+                    "model": {
+                        "n_layer": cfg.n_layer,
+                        "n_head": cfg.n_head,
+                        "n_embd": cfg.n_embd,
+                        "block_size": cfg.block_size,
+                    },
+                    "single_tok_s": round(total_new / dt_single, 2),
+                    "fleet_tok_s": round(total_new / dt_fleet, 2),
+                    "single_hit_rate": round(single_hit, 4),
+                    "fleet_hit_rate": round(fleet_hit, 4),
+                    "failovers": router.failovers,
+                    "failed_over_streams": router.failed_over_streams,
+                    "crash_round": args.fleet_crash_round,
+                    "alive": sum(router.alive),
+                    "dropped": dropped,
+                    "parity_checked": parity_checked,
+                    "greedy_match_frac": round(match / max(total, 1), 4),
+                    "spill_readopted_pages": sum(
+                        e.spill_readopted_pages for e in router.engines
+                    ),
+                    "spill": router.spill.stats(),
+                    "pages_conserved": True,
+                    "procs": True,
+                    "proc_failovers": router.proc_failovers,
+                    "worker_pids": [rep.pid for rep in replicas],
+                    "transport": transport,
+                    "rpc_p50_ms": transport["rpc_p50_ms"],
+                    "rpc_p95_ms": transport["rpc_p95_ms"],
+                    "wire_bytes": transport["wire_bytes"],
+                    "router_compiles_delta": 0,
+                    "compile_counts": ServeEngine.compile_stats(),
+                }
+            )
+        )
+        return 0
+    finally:
+        faults.clear()
+        for proc, _port in procs:
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
 
 
 def _longctx_bench(args) -> int:
@@ -1041,6 +1265,15 @@ def main() -> int:
     ap.add_argument("--fleet-crash-round", type=int, default=6,
                     help="--fleet: router round at which the armed "
                     "engine_crash kills the busiest replica")
+    ap.add_argument("--procs", action="store_true",
+                    help="--fleet: replicas are separate worker PROCESSES "
+                    "(sampling/fleet_proc.py) behind the framed socket "
+                    "transport, the single-engine reference runs in its "
+                    "own worker, and the mid-trace fault is a real kill "
+                    "-9 of the busiest worker. The serve_fleet line adds "
+                    "procs/proc_failovers/rpc_p50_ms/rpc_p95_ms/"
+                    "wire_bytes (docs/ROBUSTNESS.md 'Cross-process "
+                    "fleet')")
     ap.add_argument("--prefix-templates", type=int, default=2,
                     help="distinct shared system prompts in the workload")
     ap.add_argument("--template-tokens", type=int, default=0,

@@ -17,7 +17,11 @@ the cross-request prefix cache on and per-point/headline
 prefix-affinity FleetRouter with its shared host-RAM KV spill tier
 (sampling/fleet.py; docs/ROBUSTNESS.md "Fleet serving & failover") through
 a synchronous step loop, and points + headline carry fleet_size /
-failovers / fleet-wide prefix_hit_rate / spill_hits:
+failovers / fleet-wide prefix_hit_rate / spill_hits. Adding `--procs`
+promotes every replica to a worker PROCESS behind the framed socket
+transport (sampling/fleet_proc.py; docs/ROBUSTNESS.md "Cross-process
+fleet") — the parent builds no engine and compiles nothing, and points +
+headline add rpc_p50_ms / rpc_p95_ms / wire_bytes:
 
     python tools/loadgen.py --process poisson --rates 20,60 \
         [--scheduler slo] [--ttl-s 2.0] [--slo-ttft-ms 500 --slo-tpot-ms 50] \
@@ -371,6 +375,16 @@ def main() -> int:
                     "fleet_size / failovers / fleet-wide prefix_hit_rate "
                     "/ spill_hits (docs/ROBUSTNESS.md 'Fleet serving & "
                     "failover'). Incompatible with --hot-swap and --tp")
+    ap.add_argument("--procs", action="store_true",
+                    help="--fleet: replicas are separate worker PROCESSES "
+                    "(sampling/fleet_proc.py) behind the framed socket "
+                    "transport — the parent builds no engine and compiles "
+                    "nothing; every point drives the same worker fleet. "
+                    "Points and headline add rpc_p50_ms / rpc_p95_ms / "
+                    "wire_bytes (docs/ROBUSTNESS.md 'Cross-process "
+                    "fleet'). Round decomposition reads zero (the rounds "
+                    "run in the workers); fcfs scheduler and --overlap "
+                    "off only")
     ap.add_argument("--overlap", type=str, default="off",
                     help="round-overlap dispatch mode for every engine "
                     "(docs/SERVING.md 'Round-overlap dispatch'): 'off', "
@@ -416,6 +430,15 @@ def main() -> int:
         if args.hot_swap or args.tp:
             ap.error("--fleet is incompatible with --hot-swap and --tp")
         args.prefix_cache = True  # the router's affinity target
+    if args.procs:
+        if not args.fleet:
+            ap.error("--procs requires --fleet N (it spawns the replicas)")
+        if args.scheduler != "fcfs":
+            ap.error("--procs workers run the default fcfs scheduler")
+        if args.overlap != "off":
+            ap.error("--procs workers run with --overlap off")
+        if args.max_backlog_pages:
+            ap.error("--procs workers run with an unbounded backlog")
     if not args.num_pages:
         pages_per_slot = -(-args.block_size // args.page_size)
         args.num_pages = (
@@ -448,7 +471,47 @@ def main() -> int:
         n_head=args.n_head,
         n_embd=args.n_embd,
     )
-    params = GPT.init(cfg, jax.random.PRNGKey(args.seed))
+    worker_procs: tp.List[tp.Any] = []
+    proc_replicas: tp.List[tp.Any] = []
+    if args.procs:
+        # The parent builds no params and no engine: the replicas are
+        # worker processes (own CPU mesh, own jit cache, same-seed
+        # params), reused across every offered-load point. Warm each
+        # worker's full compile grid over the wire so the first point's
+        # percentiles measure scheduling, not worker-side compiles.
+        import dataclasses as _dc
+
+        from midgpt_tpu.sampling.fleet_proc import (
+            connect_replica,
+            parent_jax_config,
+            spawn_workers,
+        )
+
+        spec = {
+            "model": _dc.asdict(cfg),
+            "seed": args.seed,
+            "engine": {
+                "max_slots": args.max_slots,
+                "page_size": args.page_size,
+                "num_pages": args.num_pages,
+                "prefill_chunk": args.prefill_chunk,
+                "decode_chunk": args.decode_chunk,
+                "cache_dtype": "float32",
+            },
+            "cpu_devices": args.cpu_devices or 1,
+            "jax_config": parent_jax_config(),
+        }
+        worker_procs = spawn_workers(spec, args.fleet)
+        proc_replicas = [
+            connect_replica(port, retry_base_s=0.05)
+            for _, port in worker_procs
+        ]
+        for rep in proc_replicas:
+            _warm_compile_grid(
+                rep, cfg, args.decode_chunk, args.page_size, args.seed
+            )
+    else:
+        params = GPT.init(cfg, jax.random.PRNGKey(args.seed))
     on_tpu = jax.default_backend() == "tpu"
     cache_dtype = jnp.bfloat16 if on_tpu else jnp.float32
 
@@ -502,8 +565,12 @@ def main() -> int:
     # page-table indirection over the SAME program set — the grid below
     # stays exhaustive over the prefix-cache path with zero extra shapes,
     # and a warm run proving that is cheaper than trusting it.
-    warm = make_engine()
-    _warm_compile_grid(warm, cfg, args.decode_chunk, args.page_size, args.seed)
+    warm = None
+    if not args.procs:
+        warm = make_engine()
+        _warm_compile_grid(
+            warm, cfg, args.decode_chunk, args.page_size, args.seed
+        )
 
     # --hot-swap: one verified checkpoint (training/checkpoint.py sha256
     # manifest) restored once; every point stages the same candidate, so
@@ -567,14 +634,27 @@ def main() -> int:
                 assert_fleet_conserved,
             )
 
-            # One recorder across the replicas (distinct tids): the
-            # decomposition is a fleet-wide round picture for this point.
-            router = FleetRouter(
-                [
-                    make_engine(obs, obs_tid=f"replica{k}")
-                    for k in range(args.fleet)
-                ]
-            )
+            if args.procs:
+                # Fresh router per point (per-point ledger/counters) over
+                # the PERSISTENT worker fleet: the workers' jit caches and
+                # tries stay warm across points, like module-level jits do
+                # for in-process replicas. Hit rate and wire bytes are
+                # deltas over this point's drive; rpc percentiles are
+                # transport-lifetime distributions.
+                pm0 = sum(r._prefix_matched_tokens for r in proc_replicas)
+                pa0 = sum(r._prefix_matchable_tokens for r in proc_replicas)
+                router = FleetRouter(proc_replicas)
+                wire0 = router.transport_stats()["wire_bytes"]
+            else:
+                # One recorder across the replicas (distinct tids): the
+                # decomposition is a fleet-wide round picture for this
+                # point.
+                router = FleetRouter(
+                    [
+                        make_engine(obs, obs_tid=f"replica{k}")
+                        for k in range(args.fleet)
+                    ]
+                )
             records = _drive_fleet_point(
                 router, reqs, arrivals, args.ttl_s or None
             )
@@ -586,7 +666,19 @@ def main() -> int:
             stats["fleet_size"] = args.fleet
             stats["failovers"] = router.failovers
             stats["spill_hits"] = router.spill.readopted
-            stats["prefix_hit_rate"] = round(router.prefix_hit_rate(), 4)
+            if args.procs:
+                pm1 = sum(r._prefix_matched_tokens for r in proc_replicas)
+                pa1 = sum(r._prefix_matchable_tokens for r in proc_replicas)
+                stats["prefix_hit_rate"] = round(
+                    (pm1 - pm0) / max(pa1 - pa0, 1), 4
+                )
+                transport = router.transport_stats()
+                stats["rpc_p50_ms"] = transport["rpc_p50_ms"]
+                stats["rpc_p95_ms"] = transport["rpc_p95_ms"]
+                stats["wire_bytes"] = transport["wire_bytes"] - wire0
+                stats["proc_failovers"] = router.proc_failovers
+            else:
+                stats["prefix_hit_rate"] = round(router.prefix_hit_rate(), 4)
             decomp = obs.round_decomp()
             stats["rounds"] = decomp["rounds"]
             stats["round_host_ms"] = {
@@ -603,8 +695,8 @@ def main() -> int:
                 "p50": decomp["device_wait"]["p50_ms"],
                 "p95": decomp["device_wait"]["p95_ms"],
             }
-            stats["overlap_mode"] = warm.overlap
-            stats["round_group"] = warm.round_group
+            stats["overlap_mode"] = warm.overlap if warm else "off"
+            stats["round_group"] = warm.round_group if warm else 1
             stats["overlap_hidden_ms"] = {
                 "p50": decomp["overlap_hidden"]["p50_ms"],
                 "p95": decomp["overlap_hidden"]["p95_ms"],
@@ -699,7 +791,8 @@ def main() -> int:
         json.dumps(
             {
                 "bench": "serve_slo",
-                "backend": jax.default_backend(),
+                # --procs: the workers' backend (the parent runs no engine)
+                "backend": "cpu" if args.procs else jax.default_backend(),
                 "process": args.process,
                 "scheduler": args.scheduler,
                 "seed": args.seed,
@@ -723,7 +816,7 @@ def main() -> int:
                 # engine must not be comparable-by-accident with
                 # single-chip curves (ServeEngine.stats() carries the same)
                 "tp": args.tp or None,
-                "mesh": warm.mesh_shape(),
+                "mesh": warm.mesh_shape() if warm else None,
                 "max_backlog_pages": args.max_backlog_pages or None,
                 "points": points,
                 # hottest-point headline numbers (driver contract fields)
@@ -746,6 +839,12 @@ def main() -> int:
                 "fleet_size": args.fleet or None,
                 "failovers": worst.get("failovers") if args.fleet else None,
                 "spill_hits": worst.get("spill_hits") if args.fleet else None,
+                # --procs: cross-process transport headline, hottest point
+                # (docs/ROBUSTNESS.md "Cross-process fleet")
+                "procs": bool(args.procs),
+                "rpc_p50_ms": worst.get("rpc_p50_ms") if args.procs else None,
+                "rpc_p95_ms": worst.get("rpc_p95_ms") if args.procs else None,
+                "wire_bytes": worst.get("wire_bytes") if args.procs else None,
                 # --hot-swap: the version transition every point rode
                 # (docs/ROBUSTNESS.md 'Zero-downtime model ops'); slo_ok
                 # below is then the "curve stays flat through the swap"
@@ -761,6 +860,20 @@ def main() -> int:
             }
         )
     )
+    # --procs: explicit teardown of the worker fleet. Error paths need no
+    # handling here — workers watch os.getppid() and self-exit when this
+    # process dies (fleet_proc.run_worker's orphan check).
+    if args.procs:
+        import subprocess
+
+        for rep in proc_replicas:
+            rep.close()
+        for proc, _port in worker_procs:
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
     return 0
 
 
