@@ -34,6 +34,57 @@ import numpy as np
 
 BASELINE_MFU = 0.478  # reference 1.5B on v3-128 (BASELINE.md)
 
+# The dead-tunnel probe runs in a CHILD PROCESS. r19's in-process watchdog
+# ran the trivial dispatch on a worker thread with a timed join — but a
+# backend init that hangs in native code HOLDING THE GIL (verified r20: the
+# axon plugin's first contact wedges inside C++ before any Python bytecode
+# can run again) starves the watchdog thread itself, so the deadline never
+# fired and the bench still hung to the driver's timeout. A subprocess is
+# immune: the parent's timed wait() needs nothing from the child's
+# interpreter, and SIGKILL ends a native-code hang that no in-process
+# mechanism can. The child honors the same MIDGPT_PLATFORM /
+# MIDGPT_CPU_DEVICES selection and the MIDGPT_FAULTS `hang_step` hook the
+# in-process probe did (the contract test models the dead tunnel with it).
+_PROBE_CHILD_SRC = """
+import os
+import jax
+if os.environ.get("MIDGPT_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["MIDGPT_PLATFORM"])
+    if os.environ.get("MIDGPT_CPU_DEVICES"):
+        from midgpt_tpu.utils.compat import set_cpu_device_count
+        set_cpu_device_count(int(os.environ["MIDGPT_CPU_DEVICES"]))
+from midgpt_tpu.robustness import faults
+if os.environ.get("MIDGPT_FAULTS"):
+    faults.activate_plan(os.environ["MIDGPT_FAULTS"])
+if faults.should_fire("hang_step"):
+    import threading
+    threading.Event().wait()  # the dead tunnel, modeled: never returns
+import jax.numpy as jnp
+# Touch the backend end to end: placement + compute + host sync.
+assert float(jnp.zeros((8, 128)).sum()) == 0.0
+"""
+
+
+def _backend_reachable(deadline_s: float) -> bool:
+    """Fork a child, dispatch a trivial op there, bounded join.
+
+    True only when the child lands the dispatch inside the budget; a
+    timeout (child killed) or a crashed child both report unreachable —
+    either way the real bench would not have produced a number."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CHILD_SRC],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=deadline_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0
+
 
 def main() -> int:
     parser = argparse.ArgumentParser()
@@ -81,52 +132,22 @@ def main() -> int:
                         "comes out instead of a silent hang")
     args = parser.parse_args()
 
-    if args.probe_deadline > 0:
-        # A dead TPU tunnel wedges the first device sync FOREVER, and the
-        # driver historically saw nothing until its own timeout killed the
-        # bench with an empty stdout. Bound a trivial dispatch with the
-        # hung-step watchdog BEFORE paying the model build: an unreachable
-        # backend becomes one machine-readable error line within the budget.
-        from midgpt_tpu.robustness import faults
-        from midgpt_tpu.robustness.errors import StepHangError
-        from midgpt_tpu.robustness.watchdog import StepWatchdog
-
-        if os.environ.get("MIDGPT_FAULTS"):
-            faults.activate_plan(os.environ["MIDGPT_FAULTS"])
-
-        def _probe() -> float:
-            if faults.should_fire("hang_step"):
-                # Contract-test hook: model the dead tunnel in-process (the
-                # force below never returns), same never-set-event shape as
-                # the train-loop fault.
-                import threading
-
-                threading.Event().wait()
-            import jax.numpy as jnp
-
-            # Touch the backend end to end: placement + compute + host sync.
-            return float(jnp.zeros((8, 128)).sum())
-
-        try:
-            StepWatchdog(args.probe_deadline).sync(
-                _probe, label="bench.backend_probe"
-            )
-        except StepHangError:
-            print(json.dumps({
-                "error": "backend_unreachable",
-                "metric": "train_mfu",
-                "value": None,
-                "detail": {
-                    "probe_deadline_s": args.probe_deadline,
-                    "platform_requested": os.environ.get(
-                        "MIDGPT_PLATFORM", "(default: tpu tunnel)"
-                    ),
-                    "hint": "the device backend did not answer a trivial "
-                    "dispatch inside the probe budget — dead axon tunnel or "
-                    "wedged runtime; restart the tunnel and re-run",
-                },
-            }))
-            return 1
+    if args.probe_deadline > 0 and not _backend_reachable(args.probe_deadline):
+        print(json.dumps({
+            "error": "backend_unreachable",
+            "metric": "train_mfu",
+            "value": None,
+            "detail": {
+                "probe_deadline_s": args.probe_deadline,
+                "platform_requested": os.environ.get(
+                    "MIDGPT_PLATFORM", "(default: tpu tunnel)"
+                ),
+                "hint": "the device backend did not answer a trivial "
+                "dispatch inside the probe budget — dead axon tunnel or "
+                "wedged runtime; restart the tunnel and re-run",
+            },
+        }))
+        return 1
 
     from midgpt_tpu.config import MeshConfig
 

@@ -48,6 +48,10 @@ def apply_overrides(config, pairs):
             value = raw_value.lower() == "true"
         elif current is not None:
             value = type(current)(raw_value)
+        elif "int" in ann:
+            value = int(raw_value)  # Optional[int] fields (e.g. n_kv_heads)
+        elif "float" in ann:
+            value = float(raw_value)
         else:
             value = raw_value
         node = tree

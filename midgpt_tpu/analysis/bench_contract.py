@@ -434,6 +434,104 @@ def check_serve_longctx_bench(rec: dict) -> tp.List[str]:
     return problems
 
 
+def check_serve_gqa_bench(rec: dict) -> tp.List[str]:
+    """tools/bench_serve.py --gqa profile: GQA/MQA KV-capacity A/B at a
+    fixed pool byte budget (docs/SERVING.md 'Attention variants'). The
+    load-bearing invariants:
+
+      * pages_ratio >= 0.75 * kv_groups — a GQA page is group-factor
+        smaller, so the same budget must admit (nearly) group-factor more
+        pages; the 0.75 floor absorbs the max(2, ...)/sink rounding of the
+        byte-budgeted sizing (the acceptance shape, 4x grouping, must
+        clear 3x).
+      * strictly fewer GQA preemptions on an oversubscribed trace, with
+        mha_preemptions > 0 required — a trace the MHA pool absorbs
+        without preempting proves nothing about capacity.
+      * BOTH greedy_match_frac_* == 1.0 EXACTLY — each variant's paged
+        streams vs dense-cache engine.generate on the same params; any
+        mismatch is a kernel/cache bug, not noise (capacity must be the
+        only thing the A/B varies).
+
+    kv_groups >= 2 keeps the record an actual A/B (an MHA-vs-MHA run
+    would vacuously 'match')."""
+    problems: tp.List[str] = []
+    _require(
+        rec,
+        {
+            "bench": (str,),
+            "backend": (str,),
+            "n_requests": (int,),
+            "total_new_tokens": (int,),
+            "max_slots": (int,),
+            "page_size": (int,),
+            "kv_dtype": (str,),
+            "pool_hbm_bytes": (int,),
+            "model": (dict,),
+            "kv_groups": (int,),
+            "n_kv_heads": (int,),
+            "sliding_window": (int,),
+            "attn_sinks": (int,),
+            "mha_page_bytes": (int,),
+            "gqa_page_bytes": (int,),
+            "mha_num_pages": (int,),
+            "gqa_num_pages": (int,),
+            "pages_ratio": Number,
+            "mha_slots_capacity": (int,),
+            "gqa_slots_capacity": (int,),
+            "mha_preemptions": (int,),
+            "gqa_preemptions": (int,),
+            "mha_tok_s": Number,
+            "gqa_tok_s": Number,
+            "window_reclaimed_pages": (int,),
+            "greedy_match_frac_mha": Number,
+            "greedy_match_frac_gqa": Number,
+            "compile_counts": (dict,),
+        },
+        problems,
+    )
+    if rec.get("bench") != "serve_gqa":
+        problems.append(
+            f"field 'bench' is {rec.get('bench')!r}, expected 'serve_gqa'"
+        )
+    groups = rec.get("kv_groups")
+    if isinstance(groups, int) and groups < 2:
+        problems.append(f"kv_groups {groups} < 2 — the A/B is vacuous")
+    ratio = rec.get("pages_ratio")
+    if (
+        isinstance(ratio, Number)
+        and isinstance(groups, int)
+        and ratio < 0.75 * groups
+    ):
+        problems.append(
+            f"pages_ratio {ratio} < 0.75 * kv_groups ({0.75 * groups}) — "
+            "the fixed byte budget did not convert into KV-head-scaled "
+            "page capacity"
+        )
+    pe_m, pe_g = rec.get("mha_preemptions"), rec.get("gqa_preemptions")
+    if isinstance(pe_m, int) and pe_m == 0:
+        problems.append(
+            "mha_preemptions == 0 — the trace never oversubscribed the MHA "
+            "pool, so the preemption comparison proves nothing (shrink "
+            "pool_hbm_bytes or grow the trace)"
+        )
+    if isinstance(pe_m, int) and isinstance(pe_g, int) and pe_g >= pe_m > 0:
+        problems.append(
+            f"gqa_preemptions {pe_g} >= mha_preemptions {pe_m} — the extra "
+            "pages must buy strictly fewer recompute preemptions"
+        )
+    for key in ("greedy_match_frac_mha", "greedy_match_frac_gqa"):
+        v = rec.get(key)
+        if isinstance(v, Number) and v != 1.0:
+            problems.append(
+                f"{key} {v} != 1.0 — paged reads must be bit-identical to "
+                "dense-cache reads per variant"
+            )
+    w = rec.get("sliding_window")
+    if isinstance(w, int) and w < 0:
+        problems.append(f"sliding_window {w} < 0")
+    return problems
+
+
 def check_serve_ops_bench(rec: dict) -> tp.List[str]:
     """tools/bench_serve.py --hot-swap profile: zero-downtime model ops
     (docs/ROBUSTNESS.md 'Zero-downtime model ops'). A verified-checkpoint
@@ -890,6 +988,7 @@ PROFILES: tp.Dict[str, tp.Callable[[dict], tp.List[str]]] = {
     "serve_prefix": check_serve_prefix_bench,
     "serve_tp": check_serve_tp_bench,
     "serve_longctx": check_serve_longctx_bench,
+    "serve_gqa": check_serve_gqa_bench,
     "serve_ops": check_serve_ops_bench,
     "serve_fleet": check_serve_fleet_bench,
     "serve_slo": check_serve_slo_bench,

@@ -48,9 +48,31 @@ class AuditGeometry:
     split_k: int = 4
     tp: int = 2
     draft_n_layer: int = 1
+    # Attention-variant knobs (docs/SERVING.md "Attention variants"):
+    # n_kv_heads = 0 means MHA (KV heads == query heads); a smaller value
+    # shrinks the paged pool's head axis to the KV-head count, which is
+    # exactly what the copy census must grep. Window/sinks change masking
+    # only — pool geometry is untouched.
+    n_kv_heads: int = 0
+    sliding_window: int = 0
+    attn_sinks: int = 0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_head
 
 
 AUDIT = AuditGeometry()
+
+# Variant lowerings the audit suite must also hold the zero-copy /
+# collective-free pins on: MQA (2 query heads sharing 1 KV head — the
+# extreme grouping, so any head-fold bug in the lowering surfaces), the
+# same MQA geometry with a sliding window + sinks (masking must not add
+# pool traffic), and a GQA tensor-parallel geometry (4 query heads, 2 KV
+# heads, tp=2: one KV head — one whole query GROUP — per shard).
+AUDIT_GQA = AuditGeometry(n_kv_heads=1)
+AUDIT_GQA_WINDOW = AuditGeometry(n_kv_heads=1, sliding_window=24, attn_sinks=8)
+AUDIT_GQA_TP = AuditGeometry(n_head=4, head_dim=8, n_kv_heads=2)
 
 # The megatron sharding contract (docs/SERVING.md "Mesh-sharded serving"):
 # one activation all-reduce after the attention output projection and one
@@ -71,9 +93,15 @@ TP_LOOP_LAYERS: tp.Dict[str, tp.Union[str, int]] = {
     "tp_decode_split": "n_layer",  # split-K must not move the budget
     "tp_verify": 1,  # layer-scan body = one layer = one megatron pair
     "tp_draft_int8": "draft_n_layer",
+    # GQA must not move the budget either: grouping shrinks pool BYTES per
+    # shard, never the megatron activation all-reduce count (lowered at
+    # AUDIT_GQA_TP geometry, hence outside TP_PROGRAMS' shared-shape loop)
+    "tp_decode_gqa": "n_layer",
 }
 
-TP_PROGRAMS: tp.Tuple[str, ...] = tuple(TP_LOOP_LAYERS)
+TP_PROGRAMS: tp.Tuple[str, ...] = tuple(
+    k for k in TP_LOOP_LAYERS if k != "tp_decode_gqa"
+)
 
 # Pool/scale copy budget inside ANY serving loop body, split or not,
 # sharded or not: the KV pool aliases through the loop carry (the r5/r6
@@ -118,6 +146,24 @@ GROUP_ZERO_COLLECTIVE_KEYS: tp.Tuple[str, ...] = (
     "group4_decode_while_bodies",
 )
 
+# Attention-variant lowerings (docs/SERVING.md "Attention variants"): the
+# KV-head-shrunk pool must STILL alias through every decode loop carry —
+# grouping changes pool geometry, which is precisely the kind of change
+# that silently breaks XLA's donation/aliasing match — and window masking
+# must add zero pool traffic (it is select math on scores, not data
+# movement). Same dict-per-body report form as the split/group keys.
+VARIANT_ZERO_COPY_KEYS: tp.Tuple[str, ...] = (
+    "gqa_decode_loop_pool_copies",
+    "gqa_window_decode_loop_pool_copies",
+    "gqa_decode_int8_loop_pool_copies",
+    "gqa_decode_int8_loop_scale_copies",
+)
+
+VARIANT_ZERO_COLLECTIVE_KEYS: tp.Tuple[str, ...] = (
+    "gqa_decode_while_bodies",
+    "gqa_window_decode_while_bodies",
+)
+
 
 def tp_loop_all_reduce_budget(
     program: str, geom: AuditGeometry = AUDIT
@@ -139,12 +185,13 @@ def pool_shape(
 ) -> str:
     """HLO shape string of one KV pool buffer (the copy-census grep key).
 
-    Layout [L, H, P, ps, D] per models/gpt.py PagedKVCache; under tensor
-    parallelism the head axis shards, so the per-shard census greps
-    H // tp_shards heads.
+    Layout [L, H_kv, P, ps, D] per models/gpt.py PagedKVCache — the head
+    axis is the KV-head count (== n_head only for MHA; GQA geometries
+    shrink it by the group factor). Under tensor parallelism that same
+    axis shards, so the per-shard census greps kv_heads // tp_shards.
     """
     return (
-        f"{dtype}[{geom.n_layer},{geom.n_head // tp_shards},"
+        f"{dtype}[{geom.n_layer},{geom.kv_heads // tp_shards},"
         f"{geom.num_pages},{geom.page_size},{geom.head_dim}]"
     )
 
@@ -154,12 +201,12 @@ def scale_shape(
 ) -> str:
     """HLO shape string of an int8 pool's f32 scale side buffer.
 
-    Layout [L, P, H, ps] (page-major so the per-page quantization scales
-    gather alongside the page table).
+    Layout [L, P, H_kv, ps] (page-major so the per-page quantization
+    scales gather alongside the page table; KV-head axis like the pools).
     """
     return (
         f"f32[{geom.n_layer},{geom.num_pages},"
-        f"{geom.n_head // tp_shards},{geom.page_size}]"
+        f"{geom.kv_heads // tp_shards},{geom.page_size}]"
     )
 
 

@@ -642,6 +642,96 @@ def run_audit() -> tp.Dict[str, tp.Any]:
         )
 
     # ------------------------------------------------------------------
+    # attention-variant lowerings: GQA/MQA pools, sliding-window masking
+    # ------------------------------------------------------------------
+    # GQA shrinks the pool's head axis to the KV-head count — a geometry
+    # change, which is exactly the kind of edit that silently breaks the
+    # donation/aliasing match the decode loop depends on — so the variant
+    # lowerings must hold the same zero-in-loop-copy and collective-free
+    # pins as MHA, with the census grepping the KV-head pool shape.
+    # Window+sinks masking is select math on scores: it must add zero pool
+    # traffic. Audited at AUDIT_GQA (MQA, the extreme grouping) and
+    # AUDIT_GQA_WINDOW (same pools + window masking), f32 and int8.
+    gv = budgets.AUDIT_GQA
+    mc_gqa = GPTConfig(
+        block_size=gv.block_size,
+        vocab_size=gv.vocab_size,
+        n_layer=gv.n_layer,
+        n_head=gv.n_head,
+        n_embd=gv.n_embd,
+        n_kv_heads=gv.n_kv_heads,
+    )
+    gw = budgets.AUDIT_GQA_WINDOW
+    mc_gqa_win = dataclasses.replace(
+        mc_gqa, sliding_window=gw.sliding_window, attn_sinks=gw.attn_sinks
+    )
+    params_gqa_abs = jax.eval_shape(
+        lambda k: GPT.init(mc_gqa, k), jax.random.PRNGKey(0)
+    )
+    cache_gqa_abs = jax.eval_shape(
+        lambda: PagedKVCache.init(
+            mc_gqa, num_pages=gv.num_pages, page_size=gv.page_size,
+            dtype=jnp.float32,
+        )
+    )
+    cache_gqa8_abs = jax.eval_shape(
+        lambda: PagedKVCache.init(
+            mc_gqa, num_pages=gv.num_pages, page_size=gv.page_size,
+            dtype=jnp.int8,
+        )
+    )
+
+    def _variant_decode_lower(cfg, cache):
+        return _serve_decode_chunk.lower(
+            cfg,
+            params_gqa_abs,
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            cache,
+            jax.ShapeDtypeStruct((B, max_pages), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.bool_),
+            g.decode_chunk,
+            0.0,
+            None,
+            None,
+            "gather",
+            None,
+        ).compile().as_text()
+
+    gqa_hlo = _variant_decode_lower(mc_gqa, cache_gqa_abs)
+    gqa_win_hlo = _variant_decode_lower(mc_gqa_win, cache_gqa_abs)
+    gqa8_hlo = _variant_decode_lower(mc_gqa, cache_gqa8_abs)
+    gqa_pool = budgets.pool_shape(gv)
+    for name, hlo in (("gqa", gqa_hlo), ("gqa_window", gqa_win_hlo)):
+        assert_no_while_body_collectives(hlo, ops=COLLECTIVE_OPS)
+        v_census = while_body_collectives(hlo)
+        report[f"{name}_decode_while_bodies"] = {
+            b: len(ls) for b, ls in v_census.items()
+        }
+        assert v_census, f"{name} decode lowered without its scan loop"
+        copies = while_body_pool_copies(hlo, gqa_pool)
+        report[f"{name}_decode_loop_pool_copies"] = {
+            b: len(ls) for b, ls in copies.items()
+        }
+        assert all(not ls for ls in copies.values()), (
+            f"KV-head pool copies inside the {name} decode loop: "
+            + str({b: ls[:1] for b, ls in copies.items() if ls})
+        )
+    assert_no_while_body_collectives(gqa8_hlo, ops=COLLECTIVE_OPS)
+    for label, shape in (
+        ("pool", budgets.pool_shape(gv, "s8")),
+        ("scale", budgets.scale_shape(gv)),
+    ):
+        copies = while_body_pool_copies(gqa8_hlo, shape)
+        report[f"gqa_decode_int8_loop_{label}_copies"] = {
+            b: len(ls) for b, ls in copies.items()
+        }
+        assert all(not ls for ls in copies.values()), (
+            f"{label}-sized copies inside the int8 GQA decode loop: "
+            + str({b: ls[:1] for b, ls in copies.items() if ls})
+        )
+
+    # ------------------------------------------------------------------
     # tp serving mesh: per-program in-loop collective census
     # ------------------------------------------------------------------
     # The mesh-sharded engine's perf claim (docs/SERVING.md "Mesh-sharded
@@ -740,4 +830,56 @@ def run_audit() -> tp.Dict[str, tp.Any]:
                     "the sharded pool must alias through the loop carry"
                 )
             report[f"{name}_loop_pool_copies"] = budgets.LOOP_POOL_COPY_BUDGET
+
+        # GQA under tp (AUDIT_GQA_TP: 4 query heads, 2 KV heads, tp=2 —
+        # one KV head, i.e. one whole query GROUP, per shard). The claim
+        # docs/SERVING.md "Attention variants" makes: grouping shrinks the
+        # per-shard pool BYTES by the group factor while the in-loop
+        # all-reduce count stays exactly the megatron budget — the same
+        # 2 * n_layer the MHA tp_decode program pays, not one op more.
+        gtp = budgets.AUDIT_GQA_TP
+        mc_gtp = GPTConfig(
+            block_size=gtp.block_size,
+            vocab_size=gtp.vocab_size,
+            n_layer=gtp.n_layer,
+            n_head=gtp.n_head,
+            n_embd=gtp.n_embd,
+            n_kv_heads=gtp.n_kv_heads,
+            qkv_proj="split3",
+        )
+        params_gtp_abs = jax.eval_shape(
+            lambda k: GPT.init(mc_gtp, k), jax.random.PRNGKey(0)
+        )
+        cache_gtp_abs = jax.eval_shape(
+            lambda: PagedKVCache.init(
+                mc_gtp, num_pages=gtp.num_pages, page_size=gtp.page_size,
+                dtype=jnp.float32,
+            )
+        )
+        params_gtp = _shard_abs(
+            params_gtp_abs, serve_param_specs(params_gtp_abs, smesh)
+        )
+        cache_gtp = _shard_abs(cache_gtp_abs, serve_cache_specs(cache_gtp_abs))
+        gqa_tp_hlo = _serve_decode_chunk.lower(
+            mc_gtp, params_gtp, sds((B,), i32), cache_gtp,
+            sds((B, max_pages), i32), sds((B,), i32), sds((B,), b1),
+            g.decode_chunk, 0.0, None, None, "gather", None, smesh, 1,
+        ).compile().as_text()
+        assert_no_while_body_collectives(gqa_tp_hlo, ops=other_ops)
+        ar = while_body_collectives(gqa_tp_hlo, ops=("all-reduce",))
+        n_ar = sum(len(ls) for ls in ar.values())
+        report["tp_decode_gqa_loop_all_reduces"] = n_ar
+        gqa_budget = budgets.tp_loop_all_reduce_budget("tp_decode_gqa", gtp)
+        assert n_ar == gqa_budget, (
+            f"tp_decode_gqa: {n_ar} in-loop all-reduces, budget {gqa_budget} "
+            "— GQA must not change the megatron activation collective count"
+        )
+        gqa_shard_pool = budgets.pool_shape(gtp, "f32", gtp.tp)
+        copies = while_body_pool_copies(gqa_tp_hlo, gqa_shard_pool)
+        n_cp = sum(len(ls) for ls in copies.values())
+        assert n_cp == budgets.LOOP_POOL_COPY_BUDGET, (
+            f"tp_decode_gqa: {n_cp} in-loop {gqa_shard_pool} pool copies — "
+            "the KV-head-sharded pool must alias through the loop carry"
+        )
+        report["tp_decode_gqa_loop_pool_copies"] = budgets.LOOP_POOL_COPY_BUDGET
     return report

@@ -223,6 +223,13 @@ class ExperimentConfig:
             # tp shard, and composes only with the GSPMD schedule for now.
             if mc.n_head % tp != 0:
                 raise ValueError(f"n_head={mc.n_head} not divisible by mesh.tp={tp}")
+            if mc.kv_heads % tp != 0:
+                # GQA: the wkv column shard and the serving pool both split
+                # on whole KV heads (parallel/tp.py, parallel/serve_tp.py).
+                raise ValueError(
+                    f"n_kv_heads={mc.kv_heads} not divisible by mesh.tp={tp} "
+                    "— tp shards whole KV heads"
+                )
             if (4 * mc.n_embd) % tp != 0:
                 raise ValueError(f"4*n_embd={4 * mc.n_embd} not divisible by mesh.tp={tp}")
             if self.tp_vocab and mc.vocab_size % tp != 0 and self.mesh.pp in (1, -1):

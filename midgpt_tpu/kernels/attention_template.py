@@ -37,9 +37,22 @@ statistics tile — Mosaic-tileable with no 5-D layouts. The merge is
 per-(slot, head, row) elementwise math: under a tensor-parallel shard_map
 it runs inside each head shard with ZERO new collectives.
 
-A new attention variant (GQA, sliding window) is a new spec over this
-template: a different q BlockSpec or column-mask expression, not a fourth
-hand-written sweep.
+Variants ARE specs over this template, not new sweeps:
+
+  * GQA/MQA — q arrives with H_q = groups * H_kv heads (query head h
+    reads K/V head h // groups, consecutive grouping); the wrapper FOLDS
+    the group axis into the row axis — q (B, H_q, R, C) reshapes (free:
+    contiguous) to (B, H_kv, groups*R, C) and counts tile per group — so
+    the kernel body runs unchanged over the pool's H_kv heads with
+    groups*R rows per tile. The fold preserves the nondecreasing-counts
+    sweep bound (the last tiled row is still a maximal count) and the
+    per-row mask (each folded row carries its own count).
+  * sliding window (+ attention sinks) — a wider column-mask expression
+    (straight-line selects, no lax.cond): a row with `count` visible keys
+    keeps cols in [count - sliding_window, count) ∪ [0, attn_sinks), and
+    the page sweep additionally SKIPS pages that are fully behind every
+    row's window and past the sink prefix — the resident work per row is
+    O(window), which is what makes long windowed sessions O(1) in T.
 """
 
 from __future__ import annotations
@@ -95,6 +108,8 @@ def _tpl_kernel(
     split_k: int,
     pages_per_split: int,
     quantized: bool,
+    sliding_window: int,
+    attn_sinks: int,
 ):
     if quantized:
         ks_ref, vs_ref, *outs = rest
@@ -119,7 +134,18 @@ def _tpl_kernel(
     counts = jnp.stack([cnt_ref[b, t] for t in range(n_rows)])  # (R,)
     page0 = (si * pages_per_split + p) * page_size
 
-    @pl.when(page0 < cnt_ref[b, n_rows - 1])
+    # Sweep predicate: skip pages past the last row's visible keys, and —
+    # under a sliding window — pages wholly BEHIND every row's window
+    # (counts are nondecreasing, so row 0's window start is the minimum)
+    # unless they hold sink tokens. Python-static composition, one pl.when.
+    live = page0 < cnt_ref[b, n_rows - 1]
+    if sliding_window:
+        ahead = page0 + page_size > cnt_ref[b, 0] - sliding_window
+        if attn_sinks:
+            ahead |= page0 < attn_sinks
+        live &= ahead
+
+    @pl.when(live)
     def _compute():
         q = q_ref[0]  # (H, R, C)
         k = k_ref[:, 0]  # (H, page_size, C)
@@ -134,7 +160,16 @@ def _tpl_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (H, R, page_size) f32
         col = page0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(col < counts[None, :, None], s, MASK)
+        # ops/attention.visible_mask spelled as straight-line selects
+        # (no lax.cond — graftcheck GC001): causal/length bound, then the
+        # window [count - W, count) widened by the sink prefix [0, sinks).
+        keep = col < counts[None, :, None]
+        if sliding_window:
+            w = col >= counts[None, :, None] - sliding_window
+            if attn_sinks:
+                w |= col < attn_sinks
+            keep &= w
+        s = jnp.where(keep, s, MASK)
 
         m_new, alpha, prob, l_new = online_block(m_sc[:, :, 0], l_sc[:, :, 0], s)
         if quantized:
@@ -162,24 +197,41 @@ def _tpl_kernel(
 
 
 def paged_attention_template(
-    q: Array,  # (B, H, R, C) — head-major query rows
-    k_pages: Array,  # (H, num_pages, page_size, C) — ONE layer's pool
+    q: Array,  # (B, H_q, R, C) — head-major query rows (H_q >= pool heads)
+    k_pages: Array,  # (H_kv, num_pages, page_size, C) — ONE layer's pool
     v_pages: Array,
     page_table: Array,  # (B, max_pages) int32
     counts: Array,  # (B, R) int32 — keys visible to row r of slot b
-    k_scale: tp.Optional[Array] = None,  # (num_pages, H, page_size) f32
+    k_scale: tp.Optional[Array] = None,  # (num_pages, H_kv, page_size) f32
     v_scale: tp.Optional[Array] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
-    """Instantiate the template for one (n_rows, quantized, split_k) spec.
+    """Instantiate the template for one (n_rows, quantized, split_k,
+    kv_groups, window) spec.
 
-    Returns (B, H, R, C) in q.dtype. int8 pools require both scale side
+    Returns (B, H_q, R, C) in q.dtype. int8 pools require both scale side
     buffers; bf16/f32 pools take none. split_k is normalized to a pow2
     divisor of the table width; split_k == 1 is the classic in-kernel
     finalize, split_k > 1 emits per-partition partials and merges them
-    here (f32, ops/online_softmax) before the final dtype cast."""
-    B, H, R, C = q.shape
-    _, _, page_size, _ = k_pages.shape
+    here (f32, ops/online_softmax) before the final dtype cast.
+
+    GQA/MQA is inferred from the shapes: when q carries groups = H_q/H_kv
+    query heads per pool head, the group axis folds into the row axis
+    (module docstring) and unfolds on the way out — the kernel body and
+    every BlockSpec see plain H_kv-head geometry. sliding_window/attn_sinks
+    are static mask/sweep parameters (0 = full causal, bit-identical to
+    the windowless template)."""
+    B, HQ, R, C = q.shape
+    H, _, page_size, _ = k_pages.shape
+    groups = HQ // H
+    if groups > 1:
+        # Fold: head h = kv*groups + g, so (B, HQ, R, C) is contiguously
+        # (B, H, groups, R, C); folded row g*R + r keeps row r's count.
+        q = q.reshape(B, H, groups * R, C)
+        counts = jnp.tile(counts, (1, groups))
+    R_full, R = R, groups * R
     max_pages = page_table.shape[1]
     split_k = normalize_split_k(split_k, max_pages)
     pps = max_pages // split_k
@@ -242,6 +294,7 @@ def paged_attention_template(
         functools.partial(
             _tpl_kernel, scale=scale, page_size=page_size, n_rows=R,
             split_k=split_k, pages_per_split=pps, quantized=quantized,
+            sliding_window=sliding_window, attn_sinks=attn_sinks,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -253,11 +306,12 @@ def paged_attention_template(
         interpret=_interpret(),
     )(page_table.astype(jnp.int32), counts.astype(jnp.int32), *operands)
     if split_k == 1:
-        return out
+        return out.reshape(B, HQ, R_full, C) if groups > 1 else out
     o, m, l = out
     o = o.reshape(B, split_k, H, R, C)
     m = m.reshape(B, split_k, H, R, _STATS_LANES)[..., 0]
     l = l.reshape(B, split_k, H, R, _STATS_LANES)[..., 0]
     m, l, acc = merge_partials(m, l, o, axis=1)
     merged, _ = finalize(m, l, acc)
-    return merged.astype(q.dtype)
+    merged = merged.astype(q.dtype)
+    return merged.reshape(B, HQ, R_full, C) if groups > 1 else merged

@@ -56,6 +56,7 @@ from midgpt_tpu.kernels.attention_template import (
     paged_attention_template,
 )
 from midgpt_tpu.kernels.flash_attention import M_INIT, MASK
+from midgpt_tpu.ops.attention import visible_mask
 from midgpt_tpu.ops.online_softmax import finalize, merge_partials, online_block
 from midgpt_tpu.ops.quant import dequantize_q8
 from midgpt_tpu.utils.compat import shard_map
@@ -63,25 +64,37 @@ from midgpt_tpu.utils.compat import shard_map
 Array = jax.Array
 
 
+def _repeat_kv_heads(a: Array, groups: int, axis: int) -> Array:
+    """Broadcast K/V heads to the query head count (GQA gather lowerings).
+    Query head h reads K/V head h // groups — same consecutive-grouping
+    convention as the template's reshape spec (attention_template.py)."""
+    return a if groups == 1 else jnp.repeat(a, groups, axis=axis)
+
+
 def paged_attention_kernel(
-    q: Array,  # (B, H, C) — one query token per slot
-    k_pages: Array,  # (H, num_pages, page_size, C) — ONE layer's pool
+    q: Array,  # (B, H_q, C) — one query token per slot
+    k_pages: Array,  # (H_kv, num_pages, page_size, C) — ONE layer's pool
     v_pages: Array,
     page_table: Array,  # (B, max_pages) int32
     lengths: Array,  # (B,) int32 — valid tokens per slot (0 = inactive)
-    k_scale: tp.Optional[Array] = None,  # (num_pages, H, page_size) f32
+    k_scale: tp.Optional[Array] = None,  # (num_pages, H_kv, page_size) f32
     v_scale: tp.Optional[Array] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
-    """Paged decode attention via the kernel template. Returns (B, H, C).
+    """Paged decode attention via the kernel template. Returns (B, H_q, C).
     int8 pools require both scale side buffers; bf16 pools take none.
     Plain decode is the template's n_rows == 1 spec: the per-row count IS
-    the slot length."""
+    the slot length. GQA (H_q > H_kv) and the sliding-window/sink mask are
+    template specs too — the query-group fold and the windowed column mask
+    live in attention_template.py, shared with the verify variant."""
     out = paged_attention_template(
-        q[:, :, None, :],  # (B, H, 1, C)
+        q[:, :, None, :],  # (B, H_q, 1, C)
         k_pages, v_pages, page_table,
         lengths[:, None],  # (B, 1) counts
         k_scale, v_scale, split_k=split_k,
+        sliding_window=sliding_window, attn_sinks=attn_sinks,
     )
     return out[:, :, 0, :]
 
@@ -110,14 +123,16 @@ def _gather_pages(
 
 
 def paged_attention_gather(
-    q: Array,  # (B, H, C)
-    k_pages: Array,  # (H, num_pages, page_size, C)
+    q: Array,  # (B, H_q, C)
+    k_pages: Array,  # (H_kv, num_pages, page_size, C)
     v_pages: Array,
     page_table: Array,  # (B, max_pages) int32
     lengths: Array,  # (B,) int32
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """XLA fallback: gather each slot's pages contiguous (dequantized in
     int8 mode), then run the exact attention ops of the contiguous
@@ -140,14 +155,24 @@ def paged_attention_gather(
     streams stay token-identical to it (tests/test_split_k.py)."""
     B, H, C = q.shape
     page_size = k_pages.shape[2]
+    groups = H // k_pages.shape[0]  # GQA: query heads per K/V head
     max_pages = page_table.shape[1]
     S = max_pages * page_size
     split_k = normalize_split_k(split_k, max_pages)
     if split_k == 1:
-        kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
-        vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+        kg = _repeat_kv_heads(
+            _gather_pages(k_pages, k_scale, page_table, q.dtype), groups, 1
+        )
+        vg = _repeat_kv_heads(
+            _gather_pages(v_pages, v_scale, page_table, q.dtype), groups, 1
+        )
         scores = jnp.einsum("bhqc,bhkc->bhqk", q[:, :, None], kg)  # (B, H, 1, S)
-        valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
+        valid = visible_mask(
+            jnp.arange(S)[None, None, None, :],
+            lengths[:, None, None, None],
+            sliding_window,
+            attn_sinks,
+        )
         scores = jnp.where(valid, scores, float("-inf"))
         probs = jax.nn.softmax(
             scores.astype(jnp.float32) / math.sqrt(C), axis=-1
@@ -156,10 +181,21 @@ def paged_attention_gather(
 
     part_len = (max_pages // split_k) * page_size
     scale = 1.0 / math.sqrt(C)
-    kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
-    vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+    kg = _repeat_kv_heads(
+        _gather_pages(k_pages, k_scale, page_table, q.dtype), groups, 1
+    )
+    vg = _repeat_kv_heads(
+        _gather_pages(v_pages, v_scale, page_table, q.dtype), groups, 1
+    )
     s = jnp.einsum("bhc,bhkc->bhk", q, kg).astype(jnp.float32) * scale
-    s = jnp.where(jnp.arange(S)[None, None] < lengths[:, None, None], s, MASK)
+    s = jnp.where(
+        visible_mask(
+            jnp.arange(S)[None, None], lengths[:, None, None],
+            sliding_window, attn_sinks,
+        ),
+        s,
+        MASK,
+    )
     # Fat dot above, partitioned statistics below: scores reshape into
     # split_k independent partitions, each swept by one online block from
     # the init stats — exactly the kernel's single-block partition sweep.
@@ -205,44 +241,57 @@ def paged_attention(
     v_scale: tp.Optional[Array] = None,
     mesh: tp.Optional[Mesh] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """Dispatch: Pallas kernel on TPU, XLA gather elsewhere (interpret mode
     is orders of magnitude too slow for the serving loop — same policy as
     ops/attention.py for the flash kernel).
 
     With a tp>1 serving mesh the kernel is invoked PER SHARD through a
-    full-manual shard_map: each tp shard holds H/tp heads of q and of the
-    page pool (+ int8 scale rows), the page table and lengths ride in
-    replicated, and the per-head online-softmax sweep needs no collective at
-    all — the head axis is embarrassingly parallel. split_k rides the grid
-    (kernel) or the batched partition axis (gather) INSIDE each head shard, so
-    tensor parallelism and split-K compose with zero new collectives. The
-    gather lowering ignores `mesh`: it is plain jnp, and GSPMD partitions
-    it from the operand shardings alone."""
+    full-manual shard_map: each tp shard holds H_q/tp query heads and
+    H_kv/tp heads of the page pool (+ int8 scale rows) — under GQA the
+    shard boundary lands between whole K/V-head GROUPS, since H_q/tp =
+    groups * (H_kv/tp), so each shard's query heads read exactly its own
+    pool heads (requires n_kv_heads % tp == 0, validated by the engine) —
+    the page table and lengths ride in replicated, and the per-head
+    online-softmax sweep needs no collective at all: the head axis is
+    embarrassingly parallel, and the tp all-reduce PAYLOAD the pool feeds
+    shrinks with the pool while the COUNT stays two per layer. split_k
+    rides the grid (kernel) or the batched partition axis (gather) INSIDE
+    each head shard, so tensor parallelism, GQA, the window mask and
+    split-K all compose with zero new collectives. The gather lowering
+    ignores `mesh`: it is plain jnp, and GSPMD partitions it from the
+    operand shardings alone."""
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
         if mesh is not None and mesh.shape["tp"] > 1:
             quantized = k_scale is not None
-            pool = P("tp", None, None, None)  # (H, pages, page_size, C)
+            pool = P("tp", None, None, None)  # (H_kv, pages, page_size, C)
             in_specs = [P(None, "tp", None), pool, pool, P(), P()]
             args = [q, k_pages, v_pages, page_table, lengths]
             if quantized:
-                in_specs += [P(None, "tp", None)] * 2  # (pages, H, page_size)
+                in_specs += [P(None, "tp", None)] * 2  # (pages, H_kv, ps)
                 args += [k_scale, v_scale]
             fn = _tp_shard_map(
-                lambda *a: paged_attention_kernel(*a, split_k=split_k),
+                lambda *a: paged_attention_kernel(
+                    *a, split_k=split_k,
+                    sliding_window=sliding_window, attn_sinks=attn_sinks,
+                ),
                 mesh, tuple(in_specs), P(None, "tp", None),
             )
             return fn(*args)
         return paged_attention_kernel(
             q, k_pages, v_pages, page_table, lengths, k_scale, v_scale,
-            split_k=split_k,
+            split_k=split_k, sliding_window=sliding_window,
+            attn_sinks=attn_sinks,
         )
     if impl == "gather":
         return paged_attention_gather(
             q, k_pages, v_pages, page_table, lengths, k_scale, v_scale,
-            split_k=split_k,
+            split_k=split_k, sliding_window=sliding_window,
+            attn_sinks=attn_sinks,
         )
     raise ValueError(f"unknown paged attention impl {impl!r}")
 
@@ -253,14 +302,16 @@ def paged_attention(
 
 
 def paged_verify_attention_kernel(
-    q: Array,  # (B, T, H, C)
-    k_pages: Array,  # (H, num_pages, page_size, C)
+    q: Array,  # (B, T, H_q, C)
+    k_pages: Array,  # (H_kv, num_pages, page_size, C)
     v_pages: Array,
     page_table: Array,  # (B, max_pages) int32
     counts: Array,  # (B, T) int32 — keys visible to row t of slot b
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """Multi-row paged attention via the kernel template (n_rows == T).
     Returns (B, T, H, C). q is transposed head-major ONCE outside the
@@ -271,15 +322,16 @@ def paged_verify_attention_kernel(
     speculative chunk causal through the page table —
     GPT.verify_step_paged)."""
     out = paged_attention_template(
-        q.transpose(0, 2, 1, 3),  # (B, H, T, C)
+        q.transpose(0, 2, 1, 3),  # (B, H_q, T, C)
         k_pages, v_pages, page_table, counts,
         k_scale, v_scale, split_k=split_k,
+        sliding_window=sliding_window, attn_sinks=attn_sinks,
     )
-    return out.transpose(0, 2, 1, 3)  # (B, T, H, C)
+    return out.transpose(0, 2, 1, 3)  # (B, T, H_q, C)
 
 
 def paged_verify_attention_gather(
-    q: Array,  # (B, T, H, C)
+    q: Array,  # (B, T, H_q, C)
     k_pages: Array,
     v_pages: Array,
     page_table: Array,
@@ -287,6 +339,8 @@ def paged_verify_attention_gather(
     k_scale: tp.Optional[Array] = None,
     v_scale: tp.Optional[Array] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """XLA gather lowering of the multi-row verify attention: pages
     gathered contiguous once (dequantized in int8 mode, like
@@ -300,14 +354,24 @@ def paged_verify_attention_gather(
     mask."""
     B, T, H, C = q.shape
     page_size = k_pages.shape[2]
+    groups = H // k_pages.shape[0]  # GQA: query heads per K/V head
     max_pages = page_table.shape[1]
     S = max_pages * page_size
     split_k = normalize_split_k(split_k, max_pages)
     if split_k == 1:
-        kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
-        vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+        kg = _repeat_kv_heads(
+            _gather_pages(k_pages, k_scale, page_table, q.dtype), groups, 1
+        )
+        vg = _repeat_kv_heads(
+            _gather_pages(v_pages, v_scale, page_table, q.dtype), groups, 1
+        )
         scores = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg)
-        valid = jnp.arange(S)[None, None, None, :] < counts[:, None, :, None]
+        valid = visible_mask(
+            jnp.arange(S)[None, None, None, :],
+            counts[:, None, :, None],
+            sliding_window,
+            attn_sinks,
+        )
         scores = jnp.where(valid, scores, float("-inf"))
         probs = jax.nn.softmax(
             scores.astype(jnp.float32) / math.sqrt(C), axis=-1
@@ -316,13 +380,24 @@ def paged_verify_attention_gather(
 
     part_len = (max_pages // split_k) * page_size
     scale = 1.0 / math.sqrt(C)
-    kg = _gather_pages(k_pages, k_scale, page_table, q.dtype)
-    vg = _gather_pages(v_pages, v_scale, page_table, q.dtype)
+    kg = _repeat_kv_heads(
+        _gather_pages(k_pages, k_scale, page_table, q.dtype), groups, 1
+    )
+    vg = _repeat_kv_heads(
+        _gather_pages(v_pages, v_scale, page_table, q.dtype), groups, 1
+    )
     s = jnp.einsum("bthc,bhkc->bhtk", q.astype(kg.dtype), kg).astype(
         jnp.float32
     ) * scale  # (B, H, T, S) — the unsplit fat dot
     s = jnp.where(
-        jnp.arange(S)[None, None, None] < counts[:, None, :, None], s, MASK
+        visible_mask(
+            jnp.arange(S)[None, None, None],
+            counts[:, None, :, None],
+            sliding_window,
+            attn_sinks,
+        ),
+        s,
+        MASK,
     )
     s = s.reshape(B, H, T, split_k, part_len)
     m = jnp.full((B, H, T, split_k), M_INIT, jnp.float32)
@@ -348,44 +423,55 @@ def paged_verify_attention(
     v_scale: tp.Optional[Array] = None,
     mesh: tp.Optional[Mesh] = None,
     split_k: int = 1,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """Batched multi-row paged attention for speculative verification
     (GPT.verify_step_paged): every slot scores its k+1 candidate positions
     against its own pages in ONE call. Row t of slot b attends to
     counts[b, t] keys — the caller passes lengths[b] + t + 1, which makes
     the chunk causal through the cache: all rows' K/V are written before
-    the read, and the per-row count hides the later rows.
+    the read, and the per-row count hides the later rows. Under a sliding
+    window each row additionally masks to the last `sliding_window` of its
+    own visible keys (+ the `attn_sinks` prefix) — the window slides per
+    ROW, so the speculative chunk stays causal-consistent with plain
+    windowed decode.
 
     Dispatch mirrors `paged_attention`: the template-instantiated multi-row
     kernel on TPU (bf16 and int8 — interpret-mode parity in
     tests/test_quant_cache.py and tests/test_split_k.py), the XLA gather
-    lowering elsewhere; on a tp>1 mesh the kernel runs per shard over H/tp
-    heads via the same full-manual shard_map, collective-free, with
-    split_k riding inside each shard."""
+    lowering elsewhere; on a tp>1 mesh the kernel runs per shard over
+    H_q/tp query heads and H_kv/tp pool heads via the same full-manual
+    shard_map, collective-free, with split_k riding inside each shard."""
     if impl == "auto":
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "kernel":
         if mesh is not None and mesh.shape["tp"] > 1:
             quantized = k_scale is not None
             pool = P("tp", None, None, None)
-            row_spec = P(None, None, "tp", None)  # q/out (B, T, H, C)
+            row_spec = P(None, None, "tp", None)  # q/out (B, T, H_q, C)
             in_specs = [row_spec, pool, pool, P(), P()]
             args = [q, k_pages, v_pages, page_table, counts]
             if quantized:
                 in_specs += [P(None, "tp", None)] * 2
                 args += [k_scale, v_scale]
             fn = _tp_shard_map(
-                lambda *a: paged_verify_attention_kernel(*a, split_k=split_k),
+                lambda *a: paged_verify_attention_kernel(
+                    *a, split_k=split_k,
+                    sliding_window=sliding_window, attn_sinks=attn_sinks,
+                ),
                 mesh, tuple(in_specs), row_spec,
             )
             return fn(*args)
         return paged_verify_attention_kernel(
             q, k_pages, v_pages, page_table, counts, k_scale, v_scale,
-            split_k=split_k,
+            split_k=split_k, sliding_window=sliding_window,
+            attn_sinks=attn_sinks,
         )
     if impl == "gather":
         return paged_verify_attention_gather(
             q, k_pages, v_pages, page_table, counts, k_scale, v_scale,
-            split_k=split_k,
+            split_k=split_k, sliding_window=sliding_window,
+            attn_sinks=attn_sinks,
         )
     raise ValueError(f"unknown paged verify attention impl {impl!r}")

@@ -127,11 +127,78 @@ class GPTConfig:
     #           inner/outer carry boundary (RESULTS §1 r5). The deep
     #           llama7b configs set this.
     decode_layer_scan: bool = False
+    # Grouped-query attention (GQA/MQA): number of K/V heads. None = MHA
+    # (n_kv_heads == n_head, the reference layout — params, checkpoints and
+    # compiled programs are byte-identical to the pre-GQA repo). Set to a
+    # divisor of n_head to share each K/V head across n_head / n_kv_heads
+    # query heads (query head h reads K/V head h // group); 1 = MQA. Every
+    # KV buffer in the repo — dense KVCache, paged pools + int8 scale side
+    # buffers, trie/spill entries — shrinks to (.., n_kv_heads, ..) geometry,
+    # which is THE slots-per-HBM-byte lever (stacks with int8's 2x).
+    n_kv_heads: tp.Optional[int] = None
+    # Sliding-window attention: each query attends to its last
+    # `sliding_window` keys (plus the first `attn_sinks` sink tokens —
+    # StreamingLLM-style attention sinks, PAPERS.md). 0 = full causal
+    # attention. A row with `count` visible keys attends to columns
+    # [count - sliding_window, count) ∪ [0, min(attn_sinks, count)).
+    # Training support: attn_impl 'naive' or 'blockwise' (the flash/ring/
+    # ulysses kernels have no window mask — validated below). Serving:
+    # every paged path masks by the same rule, and the engine reclaims
+    # pages that fall fully behind the window (sampling/serve.py).
+    sliding_window: int = 0
+    attn_sinks: int = 0
+
+    def __post_init__(self):
+        if self.n_kv_heads is not None:
+            if self.n_kv_heads < 1 or self.n_head % self.n_kv_heads:
+                raise ValueError(
+                    f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
+                    f"of n_head={self.n_head} (each K/V head serves a whole "
+                    "group of query heads)"
+                )
+        if self.sliding_window != 0 and not (
+            0 < self.sliding_window < self.block_size
+        ):
+            raise ValueError(
+                f"sliding_window={self.sliding_window} must be 0 (full "
+                f"attention) or in [1, block_size={self.block_size})"
+            )
+        if self.attn_sinks < 0:
+            raise ValueError(f"attn_sinks={self.attn_sinks} must be >= 0")
+        if self.attn_sinks > 0 and self.sliding_window == 0:
+            raise ValueError(
+                "attn_sinks > 0 requires sliding_window > 0 (sinks are the "
+                "always-visible prefix OF a windowed mask; full attention "
+                "already sees them)"
+            )
+        if self.sliding_window > 0:
+            if self.attn_sinks + self.sliding_window > self.block_size:
+                raise ValueError(
+                    f"attn_sinks + sliding_window = "
+                    f"{self.attn_sinks + self.sliding_window} exceeds "
+                    f"block_size={self.block_size}"
+                )
+            if self.attn_impl not in ("naive", "blockwise"):
+                raise ValueError(
+                    f"sliding_window requires attn_impl 'naive' or "
+                    f"'blockwise' (got {self.attn_impl!r}: the flash/ring/"
+                    "ulysses training kernels carry no window mask)"
+                )
 
     @property
     def head_dim(self) -> int:
         assert self.n_embd % self.n_head == 0
         return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        """Number of K/V heads (n_head unless GQA/MQA is on)."""
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_head
+
+    @property
+    def kv_groups(self) -> int:
+        """Query heads per K/V head (1 = MHA)."""
+        return self.n_head // self.kv_heads
 
 
 @pytree_dataclass
@@ -159,6 +226,17 @@ class AttentionParams:
     wo: Array  # (D, D) output projection
     q_scale: Array  # (C,) QK-LayerNorm scale for queries
     k_scale: Array  # (C,) QK-LayerNorm scale for keys
+    # GQA/MQA (config.n_kv_heads set): the K/V projection moves to its own
+    # (2, n_kv_heads * C, D) leaf — k then v along the leading axis — and
+    # wqkv shrinks to the (1, D, D) query projection. Separate leaves keep
+    # both Megatron column shards clean at DIFFERENT head counts: wqkv's
+    # output axis splits by whole query heads, wkv's by whole K/V heads
+    # (parallel/tp.py; requires n_kv_heads % tp == 0). None for MHA — the
+    # leaf vanishes from the pytree, so MHA params, checkpoints and
+    # compiled programs are byte-identical to the pre-GQA repo, and a GQA
+    # checkpoint fails loudly (missing/extra leaf) against an MHA config
+    # instead of silently permuting rows.
+    wkv: tp.Optional[Array] = None
 
 
 @pytree_dataclass
@@ -205,8 +283,8 @@ class KVCache:
     full padded forward per token (reference sample.py:72-94); this is the
     named upgrade in BASELINE.json."""
 
-    k: Array  # (n_layer, B, n_head, S, head_dim)
-    v: Array  # (n_layer, B, n_head, S, head_dim)
+    k: Array  # (n_layer, B, n_kv_heads, S, head_dim)
+    v: Array  # (n_layer, B, n_kv_heads, S, head_dim)
     length: Array  # () int32: number of valid positions
 
     @staticmethod
@@ -214,7 +292,7 @@ class KVCache:
         shape = (
             config.n_layer,
             batch_size,
-            config.n_head,
+            config.kv_heads,
             config.block_size,
             config.head_dim,
         )
@@ -229,8 +307,11 @@ class KVCache:
 class PagedKVCache:
     """Paged decode cache for the continuous-batching serving engine.
 
-    K/V live in a shared pool of fixed-size pages, (n_layer, n_head,
-    num_pages, page_size, head_dim) per tensor, and a request occupies
+    K/V live in a shared pool of fixed-size pages, (n_layer, n_kv_heads,
+    num_pages, page_size, head_dim) per tensor — the head axis is the K/V
+    head count, so GQA/MQA configs shrink every page (and its int8 scale
+    rows) by the group factor, which is what turns the grouping into pages
+    per HBM byte — and a request occupies
     whatever pages the host-side allocator (sampling/serve.py PageAllocator)
     hands it — so device memory holds O(sum of used lengths) instead of
     `n_slots * block_size` (the KVCache sizing above). Page 0 is the SINK:
@@ -253,7 +334,7 @@ class PagedKVCache:
 
     **Int8 storage mode** (dtype=jnp.int8): K/V pages are stored int8 with
     f32 absmax scales in small side buffers `k_scale`/`v_scale` of shape
-    (n_layer, num_pages, n_head, page_size) — one scale per written K/V
+    (n_layer, num_pages, n_kv_heads, page_size) — one scale per written K/V
     vector per head (ops/quant.py: a page fills incrementally through the
     scatter write paths, so scale granularity cannot be coarser than a
     position without requantizing already-written columns). The layout
@@ -266,9 +347,9 @@ class PagedKVCache:
     before they are next read (the write-before-read invariant,
     docs/SERVING.md). In bf16 mode both scale fields are None."""
 
-    k: Array  # (n_layer, n_head, num_pages, page_size, head_dim)
+    k: Array  # (n_layer, n_kv_heads, num_pages, page_size, head_dim)
     v: Array
-    # int8 mode only: f32 absmax scales, (n_layer, num_pages, n_head,
+    # int8 mode only: f32 absmax scales, (n_layer, num_pages, n_kv_heads,
     # page_size); None in bf16 mode (the leaves simply vanish from the
     # pytree, so bf16 programs are byte-identical to the pre-int8 repo).
     k_scale: tp.Optional[Array] = None
@@ -283,13 +364,13 @@ class PagedKVCache:
     ) -> "PagedKVCache":
         shape = (
             config.n_layer,
-            config.n_head,
+            config.kv_heads,
             num_pages,
             page_size,
             config.head_dim,
         )
         if jnp.dtype(dtype) == jnp.int8:
-            sshape = (config.n_layer, num_pages, config.n_head, page_size)
+            sshape = (config.n_layer, num_pages, config.kv_heads, page_size)
             return PagedKVCache(
                 k=jnp.zeros(shape, jnp.int8),
                 v=jnp.zeros(shape, jnp.int8),
@@ -305,8 +386,10 @@ class PagedKVCache:
         `pool_hbm_bytes`). Deliberately excludes the int8 scale side
         buffers: the budget governs the page pools (what doubles), and the
         +4/head_dim side buffer is reported separately via
-        ServeEngine.cache_hbm_bytes() so drivers see the true spend."""
-        per_tok = config.n_layer * config.n_head * config.head_dim
+        ServeEngine.cache_hbm_bytes() so drivers see the true spend.
+        Uses the K/V head count: a GQA page is group-factor smaller, so a
+        fixed byte budget admits group-factor more pages."""
+        per_tok = config.n_layer * config.kv_heads * config.head_dim
         return 2 * per_tok * page_size * jnp.dtype(dtype).itemsize
 
     @property
@@ -385,6 +468,18 @@ def _gather_layer_kv(
     return dequantize_q8(g, sg).astype(out_dtype)
 
 
+def _repeat_kv(config: "GPTConfig", a: Array, axis: int) -> Array:
+    """Broadcast K/V heads to the query head count for GQA (no-op for MHA).
+
+    Query head h reads K/V head h // kv_groups (consecutive grouping), so
+    the repeat along the head axis places each K/V head's copies exactly at
+    its group's query-head indices — the same convention the paged kernel
+    template realizes as a free (B, H_q, R, C) -> (B, H_kv, G*R, C)
+    reshape (kernels/attention_template.py)."""
+    g = config.kv_groups
+    return a if g == 1 else jnp.repeat(a, g, axis=axis)
+
+
 def _remat_policy(name: str):
     if name == "none":
         return jax.checkpoint_policies.nothing_saveable
@@ -431,14 +526,28 @@ class GPT:
 
         def init_block(k: KeyArray) -> BlockParams:
             k_attn, k_proj, k_up, k_down = jax.random.split(k, 4)
-            attn = AttentionParams(
-                # iid rows: the (3, D, D) reshape of a (3D, D) init is the
-                # same distribution as the reference's flat fused projection
-                wqkv=_linear_init(k_attn, 3 * D, D).reshape(3, D, D),
-                wo=_linear_init(k_proj, D, D),
-                q_scale=jnp.ones((C,)),
-                k_scale=jnp.ones((C,)),
-            )
+            if config.n_kv_heads is None:
+                attn = AttentionParams(
+                    # iid rows: the (3, D, D) reshape of a (3D, D) init is
+                    # the same distribution as the reference's flat fused
+                    # projection
+                    wqkv=_linear_init(k_attn, 3 * D, D).reshape(3, D, D),
+                    wo=_linear_init(k_proj, D, D),
+                    q_scale=jnp.ones((C,)),
+                    k_scale=jnp.ones((C,)),
+                )
+            else:
+                # GQA: q at full width, k/v at n_kv_heads * C each (iid rows
+                # again — one init per projection, split keys).
+                KVD = config.kv_heads * C
+                k_q, k_kv = jax.random.split(k_attn)
+                attn = AttentionParams(
+                    wqkv=_linear_init(k_q, D, D).reshape(1, D, D),
+                    wo=_linear_init(k_proj, D, D),
+                    q_scale=jnp.ones((C,)),
+                    k_scale=jnp.ones((C,)),
+                    wkv=_linear_init(k_kv, 2 * KVD, D).reshape(2, KVD, D),
+                )
             if config.n_experts > 0:
                 E = config.n_experts
                 k_router, k_up, k_down = jax.random.split(k_up, 3)
@@ -468,8 +577,8 @@ class GPT:
     @staticmethod
     def _qkv_weights(
         config: GPTConfig, block: BlockParams
-    ) -> tp.Tuple[Array, Array, Array]:
-        """(wqkv (3,D,D), q_scale, k_scale), rope_style-adjusted.
+    ) -> tp.Tuple[Array, tp.Optional[Array], Array, Array]:
+        """(wqkv, wkv | None, q_scale, k_scale), rope_style-adjusted.
 
         For rope_style='split', conjugate by the per-head C permutation on
         the WEIGHT side (one (2,D,D)-sized gather per layer, ~µs) instead of
@@ -477,40 +586,62 @@ class GPT:
         interleaved pair (2i, 2i+1) at (i, i+C/2), so RoPE can use
         contiguous rotate-half. QK-norm and QK^T are permutation-invariant;
         v/att/wo untouched. Stored weights stay in the reference convention
-        — checkpoints need no migration."""
-        wqkv = block.attn.wqkv
+        — checkpoints need no migration. Under GQA the same permutation
+        applies to the q rows of wqkv (per query head) and the k rows of
+        wkv[0] (per K/V head); wkv[1] (v) is untouched."""
+        wqkv, wkv = block.attn.wqkv, block.attn.wkv
         q_scale, k_scale = block.attn.q_scale, block.attn.k_scale
         if config.rope_style == "split":
             from midgpt_tpu.ops.rope import split_permutation
 
             D, H, C = config.n_embd, config.n_head, config.head_dim
             perm = split_permutation(C)
-            wqk = wqkv[:2].reshape(2, H, C, D)[:, :, perm, :].reshape(2, D, D)
-            wqkv = jnp.concatenate((wqk, wqkv[2:]), axis=0)
+            if wkv is None:
+                wqk = wqkv[:2].reshape(2, H, C, D)[:, :, perm, :].reshape(2, D, D)
+                wqkv = jnp.concatenate((wqk, wqkv[2:]), axis=0)
+            else:
+                HK, KVD = config.kv_heads, config.kv_heads * C
+                wqkv = wqkv.reshape(H, C, D)[:, perm, :].reshape(1, D, D)
+                wk = wkv[:1].reshape(HK, C, D)[:, perm, :].reshape(1, KVD, D)
+                wkv = jnp.concatenate((wk, wkv[1:]), axis=0)
             q_scale, k_scale = q_scale[perm], k_scale[perm]
-        return wqkv, q_scale, k_scale
+        return wqkv, wkv, q_scale, k_scale
 
     @staticmethod
     def _project_qkv_bhtc(
         config: GPTConfig, block: BlockParams, h: Array
     ) -> tp.Tuple[Array, Array, Array]:
-        """h (B, T, D) -> q, k, v directly HEAD-major (B, H, T, C), after
+        """h (B, T, D) -> q (B, H, T, C), k, v (B, H_kv, T, C), after
         QK-LayerNorm (no RoPE) — the attn_layout='head' projection: the
         head split rides the projection einsum's output axes instead of a
-        separate transpose copy. Same contraction, same params."""
+        separate transpose copy. Same contraction, same params. K/V come
+        out at the K/V head count; GQA callers broadcast them to the query
+        head count (_repeat_kv) only where an equal-heads kernel needs it."""
         H, C = config.n_head, config.head_dim
-        wqkv, q_scale, k_scale = GPT._qkv_weights(config, block)
-        w = wqkv.reshape(3, H, C, config.n_embd)
-        qkv = jnp.einsum("btd,xhcd->xbhtc", h, w)
-        q = head_layer_norm(qkv[0], q_scale)
-        k = head_layer_norm(qkv[1], k_scale)
-        return q, k, qkv[2]
+        wqkv, wkv, q_scale, k_scale = GPT._qkv_weights(config, block)
+        if wkv is None:
+            w = wqkv.reshape(3, H, C, config.n_embd)
+            qkv = jnp.einsum("btd,xhcd->xbhtc", h, w)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            HK = config.kv_heads
+            q = jnp.einsum(
+                "btd,hcd->bhtc", h, wqkv.reshape(H, C, config.n_embd)
+            )
+            kv = jnp.einsum(
+                "btd,xhcd->xbhtc", h, wkv.reshape(2, HK, C, config.n_embd)
+            )
+            k, v = kv[0], kv[1]
+        q = head_layer_norm(q, q_scale)
+        k = head_layer_norm(k, k_scale)
+        return q, k, v
 
     @staticmethod
     def _project_qkv(
         config: GPTConfig, block: BlockParams, h: Array
     ) -> tp.Tuple[Array, Array, Array]:
-        """h (B, T, D) -> q, k, v (B, T, H, C) after QK-LayerNorm (no RoPE).
+        """h (B, T, D) -> q (B, T, H, C), k, v (B, T, H_kv, C) after
+        QK-LayerNorm (no RoPE).
 
         Sequence-major (B, T, H, C) is the layout the fused projection
         produces with a plain reshape; the flash kernel consumes it natively,
@@ -525,19 +656,42 @@ class GPT:
                      the merged 3D axis (a reshard); the batched form keeps
                      each third independently column-sharded, zero
                      collectives. The runtime selects this when mesh tp > 1
-                     (training/train.py)."""
+                     (training/train.py).
+
+        GQA (config.n_kv_heads set, AttentionParams.wkv) keeps the same two
+        lowerings: 'fused' concatenates the q and k/v weights into ONE
+        (D + 2*H_kv*C, D) matmul with a contiguous split; 'split3' runs the
+        q einsum and the batched k/v einsum separately so each stays
+        independently column-sharded at its own head count. K/V emerge at
+        the K/V head count — paged writes store them as-is, equal-heads
+        attention kernels get them via _repeat_kv."""
         B, T, D = h.shape
         H, C = config.n_head, config.head_dim
-        wqkv, q_scale, k_scale = GPT._qkv_weights(config, block)
-        if config.qkv_proj == "split3":
-            qkv = jnp.einsum("btd,xed->btxe", h, wqkv)  # (B, T, 3, D)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        wqkv, wkv, q_scale, k_scale = GPT._qkv_weights(config, block)
+        if wkv is None:
+            HK = H
+            if config.qkv_proj == "split3":
+                qkv = jnp.einsum("btd,xed->btxe", h, wqkv)  # (B, T, 3, D)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            else:
+                qkv = jnp.einsum("btd,ed->bte", h, wqkv.reshape(3 * D, D))
+                q, k, v = jnp.split(qkv, 3, axis=-1)
         else:
-            qkv = jnp.einsum("btd,ed->bte", h, wqkv.reshape(3 * D, D))
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            HK = config.kv_heads
+            KVD = HK * C
+            if config.qkv_proj == "split3":
+                q = jnp.einsum("btd,ed->bte", h, wqkv[0])
+                kv = jnp.einsum("btd,xed->btxe", h, wkv)  # (B, T, 2, KVD)
+                k, v = kv[:, :, 0], kv[:, :, 1]
+            else:
+                w = jnp.concatenate(
+                    [wqkv.reshape(D, D), wkv.reshape(2 * KVD, D)], axis=0
+                )
+                qkv = jnp.einsum("btd,ed->bte", h, w)
+                q, k, v = jnp.split(qkv, [D, D + KVD], axis=-1)
         q = head_layer_norm(q.reshape(B, T, H, C), q_scale)
-        k = head_layer_norm(k.reshape(B, T, H, C), k_scale)
-        v = v.reshape(B, T, H, C)
+        k = head_layer_norm(k.reshape(B, T, HK, C), k_scale)
+        v = v.reshape(B, T, HK, C)
         return q, k, v
 
     @staticmethod
@@ -731,6 +885,11 @@ class GPT:
             q, k, v = GPT._project_qkv_bhtc(config, params, h)  # (B,H,T,C)
             q = apply_rope(q, sin, cos, positions, style=config.rope_style)
             k = apply_rope(k, sin, cos, positions, style=config.rope_style)
+            # GQA: the flash/injected kernels take equal head counts —
+            # broadcast K/V heads to the query heads (post-RoPE, so the
+            # rotation runs at the smaller K/V width).
+            k = _repeat_kv(config, k, 1)
+            v = _repeat_kv(config, v, 1)
             if attn_fn is not None:
                 if config.dropout != 0.0 and not inference:
                     raise NotImplementedError(
@@ -746,6 +905,11 @@ class GPT:
         q, k, v = GPT._project_qkv(config, params, h)  # (B, T, H, C)
         q = apply_rope_bthc(q, sin, cos, positions, style=config.rope_style)
         k = apply_rope_bthc(k, sin, cos, positions, style=config.rope_style)
+        # GQA: broadcast K/V heads to the query head count for the
+        # equal-heads training impls (post-RoPE: the rotation and QK-norm
+        # already ran at the smaller K/V width).
+        k = _repeat_kv(config, k, 2)
+        v = _repeat_kv(config, v, 2)
 
         if attn_fn is not None:
             # Runtime-injected attention (e.g. mesh-bound ring attention for
@@ -782,6 +946,8 @@ class GPT:
                 inference=inference,
                 block_size=config.attn_block_size,
                 layout="bthc",
+                sliding_window=config.sliding_window,
+                attn_sinks=config.attn_sinks,
             )
             att = checkpoint_name(att, "attn_out")
         return att, False
@@ -912,15 +1078,19 @@ class GPT:
 
         def block_fn(x, block: BlockParams):
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)  # (B, T, H, C)
+            q, k, v = GPT._project_qkv(config, block, h)  # k/v (B, T, HK, C)
             qr = apply_rope_bthc(q, rope[0], rope[1], style=config.rope_style)
             kr = apply_rope_bthc(k, rope[0], rope[1], style=config.rope_style)
             att = multihead_attention(
-                qr, kr, v, impl=config.attn_impl, inference=True,
+                qr, _repeat_kv(config, kr, 2), _repeat_kv(config, v, 2),
+                impl=config.attn_impl, inference=True,
                 block_size=config.attn_block_size, layout="bthc",
+                sliding_window=config.sliding_window,
+                attn_sinks=config.attn_sinks,
             )
             x = GPT._attn_out_and_mlp(config, block, x, att)
-            # cache stores post-norm, post-RoPE keys and raw values, head-major
+            # cache stores post-norm, post-RoPE keys and raw values,
+            # head-major, at the K/V head count
             return x, (kr.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
 
         x, (k_layers, v_layers) = jax.lax.scan(block_fn, x, params.blocks)
@@ -950,7 +1120,8 @@ class GPT:
 
         Returns (logits (B, V) for the next token, updated cache)."""
         B = token.shape[0]
-        L, S, C, H = config.n_layer, config.block_size, config.head_dim, config.n_head
+        L, S, C = config.n_layer, config.block_size, config.head_dim
+        HK = config.kv_heads
         pos = cache.length  # () int32
         x = jnp.take(params.wte, token[:, None], axis=0)  # (B, 1, D)
         sin, cos = rope_table(C, S)
@@ -968,17 +1139,17 @@ class GPT:
         # decode loop's carry and aliases in place. L is static and small,
         # so the unroll is cheap to trace (decode has no remat concerns).
         def block_fn(carry, block_and_idx):
-            x, ck_all, cv_all = carry  # caches (L, B, H, S, C)
+            x, ck_all, cv_all = carry  # caches (L, B, HK, S, C)
             block, i = block_and_idx
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
+            q, k, v = GPT._project_qkv(config, block, h)  # k/v (B, 1, HK, C)
             q = apply_rope_bthc(
                 q, sin, cos, positions, style=config.rope_style
             ).transpose(0, 2, 1, 3)
             k = apply_rope_bthc(
                 k, sin, cos, positions, style=config.rope_style
             ).transpose(0, 2, 1, 3)
-            v = v.transpose(0, 2, 1, 3)  # all (B, H, 1, C)
+            v = v.transpose(0, 2, 1, 3)  # (B, HK, 1, C); q (B, H, 1, C)
             ck_all = jax.lax.dynamic_update_slice(
                 ck_all, k.astype(ck_all.dtype)[None], (i, 0, 0, pos, 0)
             )
@@ -986,13 +1157,26 @@ class GPT:
                 cv_all, v.astype(cv_all.dtype)[None], (i, 0, 0, pos, 0)
             )
             ck = jax.lax.dynamic_slice(
-                ck_all, (i, 0, 0, 0, 0), (1, B, H, S, C)
+                ck_all, (i, 0, 0, 0, 0), (1, B, HK, S, C)
             )[0]
             cv = jax.lax.dynamic_slice(
-                cv_all, (i, 0, 0, 0, 0), (1, B, H, S, C)
+                cv_all, (i, 0, 0, 0, 0), (1, B, HK, S, C)
             )[0]
+            # GQA: the cache holds HK heads — broadcast to the query heads
+            # for the score/PV contractions (reads only, the cache itself
+            # stays at K/V geometry).
+            ck = _repeat_kv(config, ck, 1)
+            cv = _repeat_kv(config, cv, 1)
             scores = jnp.einsum("bhqc,bhkc->bhqk", q, ck)  # (B, H, 1, S)
-            valid = jnp.arange(S)[None, None, None, :] <= pos
+            col = jnp.arange(S)[None, None, None, :]
+            valid = col <= pos
+            if config.sliding_window:
+                # Row `pos` sees count = pos + 1 keys: keep the last
+                # `sliding_window` of them plus the `attn_sinks` prefix.
+                keep = col > pos - config.sliding_window
+                if config.attn_sinks:
+                    keep |= col < config.attn_sinks
+                valid &= keep
             scores = jnp.where(valid, scores, float("-inf"))
             probs = jax.nn.softmax(
                 scores.astype(jnp.float32) / math.sqrt(C), axis=-1
@@ -1115,10 +1299,12 @@ class GPT:
             x, ck_all, cv_all, cks_all, cvs_all = carry  # pools (L,H,P,ps,C)
             block, i = block_and_idx
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)  # (B, 1, H, C)
+            q, k, v = GPT._project_qkv(config, block, h)  # k/v (B, 1, HK, C)
             q = apply_rope_positions(q, sin, cos, positions, style=config.rope_style)
             k = apply_rope_positions(k, sin, cos, positions, style=config.rope_style)
-            q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]  # (B, H, C)
+            # q1 (B, H, C); k1/v1 (B, HK, C) — written at K/V geometry, the
+            # kernel/gather handles the query-group broadcast.
+            q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]
             # Advanced-indexing scatter (quantizing in int8 mode): one
             # (B,)-indexed column write per pool — i/write_pages/offs are
             # the advanced indices (result dims (B, H, C) lead), the H and
@@ -1136,6 +1322,8 @@ class GPT:
             att = paged_attention(
                 q1, kp, vp, page_table, attn_counts, impl=attn_impl,
                 k_scale=ksp, v_scale=vsp, mesh=mesh, split_k=split_k,
+                sliding_window=config.sliding_window,
+                attn_sinks=config.attn_sinks,
             )  # (B, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att[:, None])
             return (x, ck_all, cv_all, cks_all, cvs_all), None
@@ -1216,7 +1404,7 @@ class GPT:
             x, ck_all, cv_all, cks_all, cvs_all = carry  # pools (L,H,P,ps,C)
             block, i = block_and_idx
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)  # (B, K1, H, C)
+            q, k, v = GPT._project_qkv(config, block, h)  # k/v (B, K1, HK, C)
             q = apply_rope_positions(q, sin, cos, positions, style=config.rope_style)
             k = apply_rope_positions(k, sin, cos, positions, style=config.rope_style)
             # (B, K1)-indexed column scatter: i scalar x write_pages x offs
@@ -1234,6 +1422,8 @@ class GPT:
             att = paged_verify_attention(
                 q, kp, vp, page_table, attn_counts, impl=attn_impl,
                 k_scale=ksp, v_scale=vsp, mesh=mesh, split_k=split_k,
+                sliding_window=config.sliding_window,
+                attn_sinks=config.attn_sinks,
             )  # (B, K1, H, C)
             x = GPT._attn_out_and_mlp(config, block, x, att.astype(x.dtype))
             return (x, ck_all, cv_all, cks_all, cvs_all), None
@@ -1303,7 +1493,7 @@ class GPT:
             x, ck_all, cv_all, cks_all, cvs_all = carry
             block, i = block_and_idx
             h = rms_norm(x)
-            q, k, v = GPT._project_qkv(config, block, h)  # (1, T_c, H, C)
+            q, k, v = GPT._project_qkv(config, block, h)  # k/v (1, T_c, HK, C)
             qr = apply_rope_bthc(q, sin, cos, positions, style=config.rope_style)
             kr = apply_rope_bthc(k, sin, cos, positions, style=config.rope_style)
             # kr[0]/v[0] are (T_c, H, C) — the advanced-index scatter's
@@ -1322,12 +1512,21 @@ class GPT:
             # the gather in int8 mode); every chunk row attends to the same
             # buffer under its own length mask (same
             # mask-then-scale-then-f32-softmax order as decode_step).
-            H = config.n_head
             kg = _gather_layer_kv(kp, ksp, page_table[0], x.dtype)
             vg = _gather_layer_kv(vp, vsp, page_table[0], x.dtype)
+            # GQA: gathered buffers are (HK, S, C) — broadcast to the query
+            # head count for the per-row masked attention.
+            kg = _repeat_kv(config, kg, 0)
+            vg = _repeat_kv(config, vg, 0)
             S = kg.shape[1]
             scores = jnp.einsum("thc,hsc->hts", qr[0].astype(kg.dtype), kg)
-            ok = jnp.arange(S)[None, None, :] < attn_counts[None, :, None]
+            col = jnp.arange(S)[None, None, :]
+            ok = col < attn_counts[None, :, None]
+            if config.sliding_window:
+                keep = col >= attn_counts[None, :, None] - config.sliding_window
+                if config.attn_sinks:
+                    keep |= col < config.attn_sinks
+                ok &= keep
             scores = jnp.where(ok, scores, float("-inf"))
             probs = jax.nn.softmax(
                 scores.astype(jnp.float32) / math.sqrt(C), axis=-1

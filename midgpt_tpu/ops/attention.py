@@ -28,6 +28,32 @@ Array = jax.Array
 NEG_INF = float("-inf")
 
 
+def visible_mask(
+    col: Array,
+    counts: Array,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
+) -> Array:
+    """THE visibility rule every attention path shares (broadcasting bool).
+
+    A row with `counts` visible keys keeps column `col` iff col < counts
+    and — under a sliding window — col is within the last `sliding_window`
+    of them OR inside the `attn_sinks` always-visible prefix
+    (StreamingLLM-style sinks). With sliding_window == 0 this is the plain
+    causal/length mask, bit-identical to the pre-window repo. Used by the
+    training paths here, the paged gather fallbacks
+    (kernels/decode_attention.py) and the dense decode/prefill masks
+    (models/gpt.py); the Pallas template spells the same expression as
+    straight-line selects in-kernel (kernels/attention_template.py)."""
+    keep = col < counts
+    if sliding_window:
+        w = col >= counts - sliding_window
+        if attn_sinks:
+            w |= col < attn_sinks
+        keep &= w
+    return keep
+
+
 def naive_causal_attention(
     q: Array,
     k: Array,
@@ -36,11 +62,18 @@ def naive_causal_attention(
     dropout_rate: float = 0.0,
     key: tp.Optional[Array] = None,
     inference: bool = True,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
-    """Materialized-scores attention, fp32 softmax. (B,H,T,C) -> (B,H,T,C)."""
+    """Materialized-scores attention, fp32 softmax. (B,H,T,C) -> (B,H,T,C).
+    sliding_window/attn_sinks restrict each row to its windowed visible set
+    (visible_mask); 0 is the reference causal mask, unchanged."""
     *_, T, C = q.shape
+    rows = jnp.arange(T)[:, None]
+    cols = jnp.arange(T)[None, :]
+    # row t sees count = t + 1 keys; cols < rows + 1 == tril
+    mask = visible_mask(cols, rows + 1, sliding_window, attn_sinks)
     scores = jnp.einsum("bhqc,bhkc->bhqk", q, k)
-    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32) / math.sqrt(C), axis=-1)
     probs = probs.astype(q.dtype)
@@ -49,7 +82,12 @@ def naive_causal_attention(
 
 
 def blockwise_causal_attention(
-    q: Array, k: Array, v: Array, block_size: int = 512
+    q: Array,
+    k: Array,
+    v: Array,
+    block_size: int = 512,
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """Online-softmax causal attention with O(T * block) memory.
 
@@ -87,8 +125,14 @@ def blockwise_causal_attention(
             k_j = kb[:, :, j]
             v_j = vb[:, :, j]
             s = jnp.einsum("bhqc,bhkc->bhqk", q_i, k_j).astype(jnp.float32) * scale
-            # causal mask: global query index >= global key index
-            gmask = (qi * blk + row_ids) >= (j * blk + col_ids)
+            # causal (optionally windowed) mask on GLOBAL indices: row
+            # g_row sees count = g_row + 1 keys (visible_mask above)
+            gmask = visible_mask(
+                j * blk + col_ids,
+                qi * blk + row_ids + 1,
+                sliding_window,
+                attn_sinks,
+            )
             s = jnp.where(gmask & (j <= qi), s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             # guard: fully-masked rows keep m_new == -inf; exp(-inf - -inf) → use where
@@ -167,6 +211,8 @@ def multihead_attention(
     inference: bool = False,
     block_size: int = 512,
     layout: str = "bhtc",
+    sliding_window: int = 0,
+    attn_sinks: int = 0,
 ) -> Array:
     """Dispatch causal attention; output layout matches the input layout.
 
@@ -192,6 +238,12 @@ def multihead_attention(
         impl = "blockwise"
     if impl != "naive" and dropout_rate != 0.0 and not inference:
         raise NotImplementedError(f"attention dropout requires impl='naive', got {impl!r}")
+    if sliding_window and impl == "flash":
+        # the flash kernel carries no window mask (GPTConfig validates this
+        # at construction; defensive for direct callers)
+        raise NotImplementedError(
+            "sliding_window requires impl 'naive' or 'blockwise'"
+        )
 
     T = q.shape[2] if layout == "bhtc" else q.shape[1]
     blk = min(block_size, T)
@@ -214,8 +266,12 @@ def multihead_attention(
         q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
     if impl == "naive":
         out = naive_causal_attention(
-            q, k, v, dropout_rate=dropout_rate, key=key, inference=inference
+            q, k, v, dropout_rate=dropout_rate, key=key, inference=inference,
+            sliding_window=sliding_window, attn_sinks=attn_sinks,
         )
     else:
-        out = blockwise_causal_attention(q, k, v, block_size=blk)
+        out = blockwise_causal_attention(
+            q, k, v, block_size=blk,
+            sliding_window=sliding_window, attn_sinks=attn_sinks,
+        )
     return out.transpose(0, 2, 1, 3) if layout == "bthc" else out

@@ -6,14 +6,19 @@ Training already proves megatron-TP end to end (parallel/tp.py); serving
 reuses exactly those parameter rules — the (3, D, D) wqkv layout was
 designed so tp shards land on whole heads (models/gpt.py AttentionParams) —
 and adds the one piece training does not have: the paged KV pool. The pool
-is (n_layer, n_head, num_pages, page_size, head_dim) per tensor, so the
-head axis is the natural tp shard: every page of every request splits into
-per-shard head slices, attention is pointwise in heads, and the ONLY
-activation collectives in a tp decode step are the two megatron all-reduces
-per layer that the row-parallel wo/w_down already pay (the in-loop
-collective census in analysis/hlo_audit.py pins exactly that). The int8
-scale side buffers (n_layer, num_pages, n_head, page_size) shard the same
-head axis at position 2.
+is (n_layer, n_kv_heads, num_pages, page_size, head_dim) per tensor, so the
+KV-head axis is the natural tp shard: every page of every request splits
+into per-shard head slices, attention is pointwise in (KV) heads — under
+GQA each shard's n_kv_heads/tp pool heads serve exactly its
+n_head/tp = groups * n_kv_heads/tp query heads, so the boundary falls
+between whole query groups (config.py validates both divisibilities) —
+and the ONLY activation collectives in a tp decode step are the two
+megatron all-reduces per layer that the row-parallel wo/w_down already pay
+(the in-loop collective census in analysis/hlo_audit.py pins exactly
+that: GQA shrinks the pool bytes per shard by the group factor, not the
+all-reduce count). The int8 scale side buffers
+(n_layer, num_pages, n_kv_heads, page_size) shard the same KV-head axis at
+position 2.
 
 Deliberately NOT sharded: the page table, lengths, and every other
 scheduler input stay replicated host-side jit inputs — the prefix-cache
@@ -43,9 +48,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from midgpt_tpu.parallel.mesh import AXES
 from midgpt_tpu.parallel.tp import tp_param_specs
 
-# PagedKVCache pool layout (L, H, P, ps, C): heads at axis 1.
+# PagedKVCache pool layout (L, H_kv, P, ps, C): KV heads at axis 1.
 POOL_SPEC = P(None, "tp", None, None, None)
-# int8 scale side buffers (L, P, H, ps): heads at axis 2.
+# int8 scale side buffers (L, P, H_kv, ps): KV heads at axis 2.
 SCALE_SPEC = P(None, None, "tp", None)
 
 

@@ -12,6 +12,10 @@ schedule falls out of sharding them over the mesh 'tp' axis:
         independently on its own D = H*C head-major feature axis — shard
         boundaries never straddle q/k/v or split a head. (Requires the
         'split3' QKV lowering, auto-selected by the runtime under tp > 1.)
+    wkv   (L, 2, H_kv*C, D) -> P(None, None, 'tp', 'fsdp')   GQA K/V
+        projection (models/gpt.py): same rule on the KV-head-major output
+        axis; each shard keeps H_kv/tp whole KV heads, matching the
+        H_q/tp = groups * H_kv/tp query heads of its wqkv shard.
     w_up  (L, 4D, D)   -> P(None, 'tp', 'fsdp')   whole MLP columns per shard
   row-parallel (shard the INPUT / contraction features):
     wo     (L, D, D)  -> P(None, 'fsdp', 'tp')
@@ -50,7 +54,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from midgpt_tpu.parallel.fsdp import fsdp_param_specs
 
 # leaf field name -> axis (from the end) that shards over 'tp'
-_COLUMN_PARALLEL = {"wqkv": 2, "w_up": 2}  # output features = axis -2
+# wkv is the GQA K/V projection (L, 2, H_kv*C, D): same column rule on its
+# own (smaller) head-major output axis — requires n_kv_heads % tp == 0
+# (config.py validates; megatron_leaf_axes returns None otherwise), so each
+# shard holds whole KV-head groups and attention stays collective-free.
+_COLUMN_PARALLEL = {"wqkv": 2, "wkv": 2, "w_up": 2}  # output features = axis -2
 _ROW_PARALLEL = {"wo": 1, "w_down": 1}  # input features = axis -1
 _VOCAB_PARALLEL = {"wte": 2, "lm_head": 2}  # vocab axis = axis -2 of (V, D)
 # MoE expert leaves (models/gpt.py MoEParams): the E axis sits after the

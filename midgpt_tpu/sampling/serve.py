@@ -674,6 +674,13 @@ class ServeEngine:
                         f"{nm} n_head={c.n_head} not divisible by mesh "
                         f"tp={n_tp} — the pool shards whole heads"
                     )
+                if c is not None and c.kv_heads % n_tp:
+                    # GQA pool shards whole KV heads; with H_q % tp == 0 the
+                    # shard boundary then falls between whole query groups.
+                    raise ValueError(
+                        f"{nm} n_kv_heads={c.kv_heads} not divisible by "
+                        f"mesh tp={n_tp} — the pool shards whole KV heads"
+                    )
             if n_tp > 1:
                 # Head-aligned qkv shards need the split3 einsum order over
                 # the same (3, D, D) params — the identical switch training
@@ -890,6 +897,13 @@ class ServeEngine:
         # mode's 2x pages shows up here as strictly fewer evictions on the
         # same trace (tests/test_quant_cache.py; reported by bench_serve).
         self.preemptions = 0
+        # Sliding-window page reclamation (config.sliding_window > 0,
+        # cache-off, non-speculative engines): pages wholly behind every
+        # future row's window (and past the sink prefix) are returned to
+        # the free list mid-request, their table entries parked on the
+        # sink page — the bounded-resident-set lever that makes windowed
+        # decode O(window) in pool pages, not O(T).
+        self.window_reclaimed_pages = 0
         # Robustness/SLO counters (reported by tools/loadgen.py and the
         # chaos serve scenarios): scheduling rounds, deadline timeouts,
         # admission sheds, client cancellations, and killed decode rounds.
@@ -1292,6 +1306,7 @@ class ServeEngine:
             "resizes": self.resizes,
             "spill_readopted_pages": self.spill_readopted_pages,
             "spill_readopt_events": self.spill_readopt_events,
+            "window_reclaimed_pages": self.window_reclaimed_pages,
             "swap_pending": self._staged_swap is not None,
             "compile_counts": self.compile_stats(),
             # unified observability schema (docs/OBSERVABILITY.md): round
@@ -1718,13 +1733,17 @@ class ServeEngine:
         scope for this fault: the poisoned_page chaos scenario runs
         cache-off, and the trie-specific fault is evict_shared_prefix)."""
         victim = max(
-            (s for s in self.slots if s is not None and s.pages),
+            (
+                s
+                for s in self.slots
+                if s is not None and any(p >= 0 for p in s.pages)
+            ),
             key=lambda s: s.admit_order,
             default=None,
         )
         if victim is None:
             return
-        page = victim.pages[0]
+        page = next(p for p in victim.pages if p >= 0)
         bad = (
             float("nan")
             if jnp.issubdtype(self.cache.k.dtype, jnp.floating)
@@ -1948,7 +1967,8 @@ class ServeEngine:
         list — page conservation becomes free_count + trie pages ==
         num_pages - 1 (tests/test_prefix_cache.py, chaos_serve.py)."""
         if self.prefix_cache is None:
-            self.allocator.free(slot.pages)
+            # -1 entries are window-reclaimed placeholders (already freed)
+            self.allocator.free(p for p in slot.pages if p >= 0)
             return
         with self._trace.span("trie.release", "prefix", self._obs_tid):
             committed = np.concatenate(
@@ -1964,7 +1984,46 @@ class ServeEngine:
             if s is not None:
                 pages = s.pages[: table.shape[1]]
                 table[i, : len(pages)] = pages
+        # Window-reclaimed entries (-1 in slot.pages) park on the sink page:
+        # the kernel sweep skips them and the mask hides their columns, but
+        # the BlockSpec index map still needs a valid physical page.
+        np.maximum(table, 0, out=table)
         return table
+
+    def _reclaim_window(self, slot: _Slot) -> None:
+        """Free this slot's pages that no FUTURE attention row can see.
+
+        Page j (positions [j*ps, (j+1)*ps)) is dead once the youngest
+        visible position has moved past it — counts only grow, so
+        (j+1)*ps <= length - sliding_window is permanent — unless it holds
+        sink-prefix tokens. Freed entries become -1 placeholders so the
+        page list keeps its LOGICAL length (position -> table column stays
+        the identity; _ensure_pages and the settle bound len(pages)*ps are
+        untouched); _page_table parks them on the sink page. Gated off
+        under the prefix cache (the trie owns shared pages' lifetime) and
+        speculative decoding (verify rollback re-reads recent history);
+        conservation becomes free + live non-placeholder == num_pages - 1."""
+        W = self.config.sliding_window
+        if (
+            not W
+            or self.prefix_cache is not None
+            or self.draft_config is not None
+        ):
+            return
+        ps = self.page_size
+        first_live = max(0, slot.length - W) // ps  # pages below are dead
+        sink_pages = -(-self.config.attn_sinks // ps)  # keep the sink prefix
+        dead = [
+            j
+            for j in range(sink_pages, first_live)
+            if slot.pages[j] >= 0
+        ]
+        if not dead:
+            return
+        self.allocator.free(slot.pages[j] for j in dead)
+        for j in dead:
+            slot.pages[j] = -1
+        self.window_reclaimed_pages += len(dead)
 
     def _page_bucket(self, max_tokens: int) -> int:
         """Smallest power-of-two page count covering `max_tokens` positions.
@@ -2057,6 +2116,7 @@ class ServeEngine:
                 )
         slot.prompt_pos += n_valid
         slot.length = slot.prompt_pos
+        self._reclaim_window(slot)  # long prompts free behind-window pages
         self.prefilled_tokens += n_valid
         if not slot.prefilling:
             if self.prefix_cache is not None:
@@ -2417,6 +2477,7 @@ class ServeEngine:
     def _append_token(self, slot_i: int, slot: _Slot, tok: int, t: float) -> bool:
         """Record one generated token; returns True if the request finished
         (and the slot was freed)."""
+        self._reclaim_window(slot)  # no-op unless config.sliding_window
         slot.generated.append(tok)
         slot.token_times.append(t)
         req = slot.request

@@ -17,6 +17,7 @@ from midgpt_tpu.analysis.bench_contract import (
     check_graftcheck,
     check_serve_bench,
     check_serve_fleet_bench,
+    check_serve_gqa_bench,
     check_serve_longctx_bench,
     check_serve_ops_bench,
     check_serve_prefix_bench,
@@ -246,6 +247,91 @@ def test_bench_serve_longctx_emits_conformant_json_line(capsys):
         "ms_round_long_split" in p
         for p in check_serve_longctx_bench(dict(rec, ms_round_long_split=0.0))
     )
+
+
+@pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)
+def test_bench_serve_gqa_emits_conformant_json_line(capsys):
+    """--gqa mode: the serve_gqa profile (GQA vs MHA KV-capacity A/B at a
+    fixed pool byte budget, docs/SERVING.md 'Attention variants') must hold
+    the one-JSON-line contract: G-fold page capacity from the same bytes,
+    strictly fewer preemptions on an oversubscribed trace, and EXACT greedy
+    parity on both arms. Tiny model — structure check, not a perf claim."""
+    out = _run_entry_point(
+        os.path.join(REPO, "tools", "bench_serve.py"),
+        [
+            "bench_serve.py",
+            "--gqa", "4",
+            "--n-requests", "8",
+            "--block-size", "128",
+            "--vocab-size", "96",
+            "--n-layer", "2",
+            "--n-head", "4",
+            "--n-embd", "32",
+            "--prefill-chunk", "16",
+            "--decode-chunk", "4",
+        ],
+        capsys,
+    )
+    rec, problems = check_bench_stdout(out, "serve_gqa")
+    assert not problems, problems
+    assert rec["kv_groups"] == 4 and rec["n_kv_heads"] == 1
+    # same bytes, 4x smaller pages -> ~4x pages (max(2,...) rounding aside)
+    assert rec["gqa_page_bytes"] * 4 == rec["mha_page_bytes"]
+    assert rec["pages_ratio"] >= 3.0
+    assert rec["mha_preemptions"] > rec["gqa_preemptions"]
+    assert rec["greedy_match_frac_mha"] == 1.0
+    assert rec["greedy_match_frac_gqa"] == 1.0
+
+
+def test_serve_gqa_checker_catches_drift():
+    """The serve_gqa gates hold on a synthetic record without running the
+    bench: the capacity conversion, the oversubscription requirement, and
+    exact two-sided parity are contract, not numbers."""
+    good = {
+        "bench": "serve_gqa", "backend": "cpu", "n_requests": 8,
+        "total_new_tokens": 96, "max_slots": 4, "page_size": 8,
+        "kv_dtype": "bf16", "pool_hbm_bytes": 100000, "model": {},
+        "kv_groups": 4, "n_kv_heads": 1, "sliding_window": 0,
+        "attn_sinks": 0, "mha_page_bytes": 4096, "gqa_page_bytes": 1024,
+        "mha_num_pages": 24, "gqa_num_pages": 96, "pages_ratio": 4.0,
+        "mha_slots_capacity": 3, "gqa_slots_capacity": 12,
+        "mha_preemptions": 16, "gqa_preemptions": 0,
+        "mha_tok_s": 100.0, "gqa_tok_s": 220.0,
+        "window_reclaimed_pages": 0,
+        "greedy_match_frac_mha": 1.0, "greedy_match_frac_gqa": 1.0,
+        "mha_cache_hbm_bytes": 98304, "gqa_cache_hbm_bytes": 98304,
+        "compile_counts": {},
+    }
+    assert check_serve_gqa_bench(good) == []
+    # an MHA-vs-MHA "A/B" is vacuous
+    assert any("kv_groups" in p
+               for p in check_serve_gqa_bench(dict(good, kv_groups=1)))
+    # the byte budget must convert into KV-head-scaled page capacity
+    assert any("pages_ratio" in p
+               for p in check_serve_gqa_bench(dict(good, pages_ratio=2.0)))
+    # a trace the MHA pool absorbs proves nothing about capacity
+    assert any(
+        "mha_preemptions" in p
+        for p in check_serve_gqa_bench(
+            dict(good, mha_preemptions=0, gqa_preemptions=0)
+        )
+    )
+    # the extra pages must buy strictly fewer preemptions
+    assert any("gqa_preemptions" in p
+               for p in check_serve_gqa_bench(dict(good, gqa_preemptions=16)))
+    # parity is exact on BOTH arms — 0.9999 is a kernel bug, not noise
+    assert any(
+        "greedy_match_frac_mha" in p
+        for p in check_serve_gqa_bench(dict(good, greedy_match_frac_mha=0.9999))
+    )
+    assert any(
+        "greedy_match_frac_gqa" in p
+        for p in check_serve_gqa_bench(dict(good, greedy_match_frac_gqa=0.9999))
+    )
+    missing = dict(good)
+    missing.pop("window_reclaimed_pages")
+    assert any("window_reclaimed_pages" in p
+               for p in check_serve_gqa_bench(missing))
 
 
 @pytest.mark.slow  # heavy long-tail: full suite only, per the tier-1 870 s gate budget (CLAUDE.md)

@@ -44,6 +44,21 @@ def test_invalid_final_state_still_rejected():
         launch_mod.apply_overrides(base, [("model_config.attn_impl", "flash")])
 
 
+def test_set_optional_int_parses_as_int():
+    """n_kv_heads is Optional[int] (None default = MHA): '--set
+    model_config.n_kv_heads=2' must become int 2 — the None current value
+    can't drive parsing, so the annotation must — and 'none' restores MHA."""
+    ov = launch_mod.apply_overrides
+    cfg = ov(base, [("model_config.n_kv_heads", "2"),
+                    ("model_config.n_head", "4")])
+    assert cfg.model_config.n_kv_heads == 2
+    assert isinstance(cfg.model_config.n_kv_heads, int)
+    assert ov(base, [("model_config.n_kv_heads", "none")]) \
+        .model_config.n_kv_heads is None
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        ov(base, [("model_config.n_kv_heads", "5")])  # not a divisor
+
+
 def test_set_optional_bool_parses_numeric_and_none():
     """loss_remat_chunks is Optional[bool] (None default): '--set
     loss_remat_chunks=0' must become bool False (not the truthy string '0'),

@@ -542,3 +542,14 @@ def test_audit_suite_passes_on_cpu_mesh():
     for key in budgets.GROUP_ZERO_COLLECTIVE_KEYS + budgets.GROUP_ZERO_COPY_KEYS:
         assert report[key], f"{key}: group program lost its scan?"
         assert all(n == zero for n in report[key].values()), key
+    # attention-variant extensions (docs/SERVING.md "Attention variants"):
+    # the KV-head-shrunk GQA/MQA pools still alias through every decode
+    # loop carry (f32 AND int8+scales), window masking adds zero pool
+    # traffic, and GQA under tp pays exactly the same megatron all-reduce
+    # budget as MHA — grouping moves pool bytes, never collectives
+    for key in budgets.VARIANT_ZERO_COLLECTIVE_KEYS + budgets.VARIANT_ZERO_COPY_KEYS:
+        assert all(n == zero for n in report[key].values()), key
+    assert report["tp_decode_gqa_loop_all_reduces"] == (
+        budgets.tp_loop_all_reduce_budget("tp_decode_gqa", budgets.AUDIT_GQA_TP)
+    )
+    assert report["tp_decode_gqa_loop_pool_copies"] == zero

@@ -469,6 +469,161 @@ def _prefix_bench(args, cfg, params, cache_dtype) -> int:
     return 0
 
 
+def _gqa_bench(args, cfg, cache_dtype) -> int:
+    """--gqa mode: KV-bytes capacity A/B ('serve_gqa' profile,
+    analysis/bench_contract.py; docs/SERVING.md 'Attention variants').
+
+    The same mixed-length greedy trace runs through an MHA engine and a
+    GQA engine (n_kv_heads = n_head / G, optionally + sliding window) at
+    the SAME fixed pool_hbm_bytes. A GQA page is G-fold smaller
+    (PagedKVCache.page_bytes), so the byte budget admits G-fold more
+    pages — which converts into admissible slots and strictly fewer
+    recompute preemptions on an oversubscribed trace. Each variant's
+    streams are compared against engine.generate on its OWN params
+    (different projection layouts are different models — cross-variant
+    token equality would be meaningless); both match fractions must be
+    EXACTLY 1.0: paged reads are bit-identical to dense-cache reads per
+    variant, so capacity is the only thing the A/B varies."""
+    import collections
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from midgpt_tpu.models.gpt import GPT, GPTConfig, PagedKVCache
+    from midgpt_tpu.sampling.engine import generate
+    from midgpt_tpu.sampling.serve import ServeEngine
+
+    G = args.gqa
+    if cfg.n_head % G:
+        raise SystemExit(f"--gqa {G} does not divide n_head={cfg.n_head}")
+    gqa_cfg = _dc.replace(
+        cfg,
+        n_kv_heads=cfg.n_head // G,
+        sliding_window=args.sliding_window,
+        attn_sinks=args.attn_sinks,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    S = cfg.block_size
+    trace = []
+    for _ in range(args.n_requests):
+        t0 = int(rng.integers(4, max(5, S // 2)))
+        m = int(rng.integers(8, max(9, min(64, S - t0))))
+        trace.append((rng.integers(0, cfg.vocab_size, t0, dtype=np.int64), m))
+    total_new = sum(m for _, m in trace)
+    ps = args.page_size
+    req_pages = [-(-(len(p) + m) // ps) for p, m in trace]
+
+    # Fixed byte budget, the independent variable: default sizes the MHA
+    # pool to ~1/3 of the trace's worst-case page demand (but always at
+    # least the largest single request), so the MHA side oversubscribes
+    # and preempts while GQA's G-fold page count absorbs the same trace.
+    mha_page_bytes = PagedKVCache.page_bytes(cfg, ps, cache_dtype)
+    pool_hbm_bytes = args.pool_hbm_bytes or mha_page_bytes * (
+        1 + max(max(req_pages), sum(req_pages) // 3)
+    )
+
+    Ref = collections.namedtuple("Ref", "tokens")
+
+    def run(vcfg):
+        params = GPT.init(vcfg, jax.random.PRNGKey(args.seed))
+        if jax.default_backend() == "tpu":
+            params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+
+        def once():
+            eng = ServeEngine(
+                vcfg,
+                params,
+                max_slots=args.max_slots,
+                page_size=ps,
+                prefill_chunk=args.prefill_chunk,
+                decode_chunk=args.decode_chunk,
+                temperature=0.0,
+                cache_dtype=cache_dtype,
+                pool_hbm_bytes=pool_hbm_bytes,
+            )
+            uids = [(eng.submit(p, m), len(p)) for p, m in trace]
+            t0 = time.perf_counter()
+            done = eng.run()
+            return eng, done, time.perf_counter() - t0, uids
+
+        once()  # warm the variant's jit shapes
+        eng, done, dt, uids = once()
+        refs = {
+            uid: Ref(
+                np.asarray(
+                    generate(
+                        vcfg, params, jnp.asarray(p, jnp.int32)[None], m,
+                        temperature=0.0,
+                    )[0]
+                )
+            )
+            for (uid, _), (p, m) in zip(uids, trace)
+        }
+        return eng, done, refs, dt, uids
+
+    eng_mha, done_mha, refs_mha, dt_mha, uids_mha = run(cfg)
+    eng_gqa, done_gqa, refs_gqa, dt_gqa, uids_gqa = run(gqa_cfg)
+
+    mean_req_pages = sum(req_pages) / len(req_pages)
+    slots = lambda eng: int((eng.allocator.num_pages - 1) // mean_req_pages)
+    print(
+        json.dumps(
+            {
+                "bench": "serve_gqa",
+                "backend": jax.default_backend(),
+                "n_requests": args.n_requests,
+                "total_new_tokens": total_new,
+                "max_slots": args.max_slots,
+                "page_size": ps,
+                "kv_dtype": args.kv_dtype,
+                "pool_hbm_bytes": pool_hbm_bytes,
+                "model": {
+                    "n_layer": cfg.n_layer,
+                    "n_head": cfg.n_head,
+                    "n_embd": cfg.n_embd,
+                    "block_size": cfg.block_size,
+                },
+                "kv_groups": G,
+                "n_kv_heads": gqa_cfg.kv_heads,
+                "sliding_window": args.sliding_window,
+                "attn_sinks": args.attn_sinks,
+                "mha_page_bytes": mha_page_bytes,
+                "gqa_page_bytes": PagedKVCache.page_bytes(
+                    gqa_cfg, ps, cache_dtype
+                ),
+                "mha_num_pages": eng_mha.allocator.num_pages,
+                "gqa_num_pages": eng_gqa.allocator.num_pages,
+                # the headline slots-per-HBM-byte win: pages (and mean-
+                # request slots) admitted by the SAME byte budget
+                "pages_ratio": round(
+                    eng_gqa.allocator.num_pages / eng_mha.allocator.num_pages,
+                    3,
+                ),
+                "mha_slots_capacity": slots(eng_mha),
+                "gqa_slots_capacity": slots(eng_gqa),
+                "mha_preemptions": eng_mha.preemptions,
+                "gqa_preemptions": eng_gqa.preemptions,
+                "mha_tok_s": round(total_new / dt_mha, 2),
+                "gqa_tok_s": round(total_new / dt_gqa, 2),
+                "window_reclaimed_pages": eng_gqa.window_reclaimed_pages,
+                "greedy_match_frac_mha": round(
+                    _greedy_match_frac(done_mha, refs_mha, uids_mha), 4
+                ),
+                "greedy_match_frac_gqa": round(
+                    _greedy_match_frac(done_gqa, refs_gqa, uids_gqa), 4
+                ),
+                "mha_cache_hbm_bytes": int(eng_mha.cache_hbm_bytes()),
+                "gqa_cache_hbm_bytes": int(eng_gqa.cache_hbm_bytes()),
+                "compile_counts": ServeEngine.compile_stats(),
+            }
+        )
+    )
+    return 0
+
+
 def _fleet_bench(args, cfg, params, cache_dtype) -> int:
     """--fleet mode: availability A/B ('serve_fleet' profile,
     analysis/bench_contract.py; docs/ROBUSTNESS.md 'Fleet serving &
@@ -1278,6 +1433,24 @@ def main() -> int:
                     help="distinct shared system prompts in the workload")
     ap.add_argument("--template-tokens", type=int, default=0,
                     help="template length (0 = 5 * page_size)")
+    ap.add_argument("--gqa", type=int, default=0,
+                    help="> 0 selects the GQA capacity A/B: the same greedy "
+                    "trace through an MHA engine and a GQA engine with "
+                    "n_kv_heads = n_head / THIS group factor, at the same "
+                    "fixed --pool_hbm_bytes (default: ~1/3 of the trace's "
+                    "MHA page demand, so the MHA side preempts). Emits the "
+                    "'serve_gqa' JSON profile: pages/slots admitted per "
+                    "byte, preemptions, and per-variant greedy parity vs "
+                    "engine.generate, required exactly 1.0 (docs/SERVING.md "
+                    "'Attention variants')")
+    ap.add_argument("--sliding-window", type=int, default=0,
+                    help="--gqa: the GQA variant also decodes with this "
+                    "sliding window (0 = full causal); reclaimed "
+                    "behind-window pages ride the line as "
+                    "window_reclaimed_pages")
+    ap.add_argument("--attn-sinks", type=int, default=0,
+                    help="--gqa: always-visible sink prefix tokens for the "
+                    "windowed variant (StreamingLLM-style)")
     ap.add_argument("--long-ctx", action="store_true",
                     help="long-context split-K A/B: decode-round latency of "
                     "ONE active slot at --t-long with the engine's auto "
@@ -1361,6 +1534,16 @@ def main() -> int:
     quantized = args.kv_dtype == "int8"
     if args.long_ctx:
         return _longctx_bench(args)
+
+    if args.gqa:
+        if quantized:
+            raise SystemExit(
+                "--gqa compares paged streams against dense-cache "
+                "engine.generate, which is only bit-exact at the baseline "
+                "cache dtype — int8 stacking is the existing quant bench's "
+                "claim; run --gqa without --kv_dtype int8"
+            )
+        return _gqa_bench(args, cfg, baseline_dtype)
 
     train_loss = None
     if (

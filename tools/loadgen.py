@@ -413,6 +413,21 @@ def main() -> int:
     ap.add_argument("--n-layer", type=int, default=2)
     ap.add_argument("--n-head", type=int, default=2)
     ap.add_argument("--n-embd", type=int, default=32)
+    ap.add_argument("--n-kv-heads", type=int, default=0,
+                    help="GQA/MQA: KV heads shared by n_head/n_kv_heads "
+                    "query-head groups (0 = MHA; docs/SERVING.md "
+                    "'Attention variants'). Shrinks KV page bytes by the "
+                    "group factor; the serve_slo model block carries the "
+                    "variant knobs so GQA curves are not comparable-by-"
+                    "accident with MHA ones")
+    ap.add_argument("--sliding-window", type=int, default=0,
+                    help="sliding-window attention: decode attends to the "
+                    "last N positions only and the engine reclaims pages "
+                    "behind the window (0 = full context)")
+    ap.add_argument("--attn-sinks", type=int, default=0,
+                    help="with --sliding-window: the first N positions "
+                    "stay visible (and their pages resident) beyond the "
+                    "window")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force CPU with this many virtual devices (0 = native)")
     ap.add_argument("--tp", type=int, default=0,
@@ -470,6 +485,9 @@ def main() -> int:
         n_layer=args.n_layer,
         n_head=args.n_head,
         n_embd=args.n_embd,
+        n_kv_heads=args.n_kv_heads or None,
+        sliding_window=args.sliding_window,
+        attn_sinks=args.attn_sinks,
     )
     worker_procs: tp.List[tp.Any] = []
     proc_replicas: tp.List[tp.Any] = []
@@ -809,6 +827,13 @@ def main() -> int:
                     "n_head": cfg.n_head,
                     "n_embd": cfg.n_embd,
                     "block_size": S,
+                    # attention-variant provenance (docs/SERVING.md
+                    # 'Attention variants'): a GQA or windowed curve has a
+                    # different KV byte budget per slot than an MHA one
+                    "n_kv_heads": cfg.kv_heads,
+                    "kv_groups": cfg.kv_groups,
+                    "sliding_window": cfg.sliding_window,
+                    "attn_sinks": cfg.attn_sinks,
                 },
                 "max_slots": args.max_slots,
                 "num_pages": args.num_pages,
